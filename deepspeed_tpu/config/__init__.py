@@ -1,0 +1,32 @@
+from deepspeed_tpu.config.config import (
+    ActivationCheckpointingConfig,
+    BF16Config,
+    CheckpointConfig,
+    CommsLoggerConfig,
+    DeepSpeedTPUConfig,
+    ElasticityConfig,
+    FlopsProfilerConfig,
+    FP16Config,
+    MoEConfig,
+    MonitorConfig,
+    OffloadDeviceEnum,
+    OffloadOptimizerConfig,
+    OffloadParamConfig,
+    OptimizerConfig,
+    PipelineParallelConfig,
+    SchedulerConfig,
+    SequenceParallelConfig,
+    TensorParallelConfig,
+    ZeroConfig,
+)
+from deepspeed_tpu.config.config_utils import AUTO, TPUConfigModel, is_auto
+
+__all__ = [
+    "AUTO", "is_auto", "TPUConfigModel", "DeepSpeedTPUConfig",
+    "OptimizerConfig", "SchedulerConfig", "FP16Config", "BF16Config",
+    "ZeroConfig", "OffloadDeviceEnum", "OffloadOptimizerConfig",
+    "OffloadParamConfig", "TensorParallelConfig", "PipelineParallelConfig",
+    "SequenceParallelConfig", "MoEConfig", "CommsLoggerConfig",
+    "FlopsProfilerConfig", "MonitorConfig", "CheckpointConfig",
+    "ElasticityConfig", "ActivationCheckpointingConfig",
+]

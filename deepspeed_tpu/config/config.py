@@ -1,0 +1,467 @@
+"""deepspeed_tpu master config.
+
+TPU-native equivalent of the reference's ``DeepSpeedConfig``
+(reference: deepspeed/runtime/config.py:651) — one JSON/dict tree parsed
+into typed sub-configs, with the batch-size triple solver
+(train_batch = micro_batch × grad_accum × dp_world, reference
+runtime/config.py batch resolution) and ``"auto"`` resolution.
+
+Key design translation for TPU:
+- ``zero_optimization.stage`` selects a *sharding layout* over the mesh's
+  ``data`` axis (stage1: optimizer state sharded; stage2: +grads via
+  reduce-scatter output shardings; stage3: +params, allgather-on-use done
+  by XLA), not a hook engine.
+- ``fp16`` exists for API compatibility but TPU-native training is bf16
+  (no loss scaling needed); enabling fp16 turns on a DynamicLossScaler for
+  parity testing.
+- parallel-topology knobs (tensor/pipeline/sequence/expert) become mesh
+  axis sizes (see deepspeed_tpu/parallel/mesh.py).
+"""
+
+import json
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import AUTO, TPUConfigModel, is_auto
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+class OptimizerConfig(TPUConfigModel):
+    """Reference: ``"optimizer": {"type": ..., "params": {...}}``
+    (runtime/config.py get_optimizer_name/params)."""
+    type: str = "adamw"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(TPUConfigModel):
+    """Reference: ``"scheduler"`` block (runtime/config.py:get_scheduler_name)."""
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Precision
+# ---------------------------------------------------------------------------
+
+class FP16Config(TPUConfigModel):
+    """Reference: runtime/fp16 configs (config.py fp16 block). On TPU fp16 is
+    discouraged; bf16 is native. Kept for API parity + loss-scaler tests."""
+    enabled: Union[bool, str] = False
+    loss_scale: float = 0.0          # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+
+class BF16Config(TPUConfigModel):
+    """Reference: ``"bf16": {"enabled": ...}`` (runtime/config.py bf16 block).
+    TPU default-on when neither fp16 nor bf16 specified explicitly is handled
+    at engine level."""
+    enabled: Union[bool, str] = False
+    #: dtype used for gradient accumulation buffers across microbatches
+    #: (reference knob: gradient_accumulation_dtype)
+    accumulate_grads_in_fp32: bool = True
+
+
+class ActivationCheckpointingConfig(TPUConfigModel):
+    """Reference: activation_checkpointing block (runtime/activation_checkpointing).
+    On TPU this maps to ``jax.checkpoint`` policies applied per transformer
+    block (remat), not manual partition/offload of activations."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    #: jax-native: remat policy name: 'none'|'full'|'dots_saveable'|
+    #: 'nothing_saveable'|'dots_with_no_batch_dims_saveable'
+    policy: str = "none"
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+
+class OffloadDeviceEnum(str, Enum):
+    """Reference: runtime/zero/offload_config.py OffloadDeviceEnum."""
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadOptimizerConfig(TPUConfigModel):
+    """Reference: runtime/zero/offload_config.py:DeepSpeedZeroOffloadOptimizerConfig.
+    On TPU 'cpu' = host DRAM via jax.device_put to CPU backend / pinned
+    host memory; 'nvme' = the C++ async-io path (deepspeed_tpu/io)."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class OffloadParamConfig(TPUConfigModel):
+    """Reference: runtime/zero/offload_config.py:DeepSpeedZeroOffloadParamConfig."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class ZeroConfig(TPUConfigModel):
+    """Reference: runtime/zero/config.py:DeepSpeedZeroConfig.
+
+    TPU semantics of ``stage``:
+      0 — pure data parallel: params/grads/opt replicated over 'data' axis.
+      1 — optimizer states sharded over 'data' (flat fp32 master partitions).
+      2 — + gradients reduce-scattered to shards (XLA emits reduce-scatter
+          from the output sharding annotation on the grad pytree).
+      3 — + parameters stored sharded (FSDP); allgather-on-use is emitted
+          and overlapped by XLA's latency-hiding scheduler, replacing the
+          reference's fetch/release hook engine
+          (runtime/zero/partitioned_param_coordinator.py).
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: Union[int, str] = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: Union[int, str] = 500_000_000
+    overlap_comm: Optional[bool] = None   # XLA overlaps automatically; kept for parity
+    offload_optimizer: OffloadOptimizerConfig = Field(default_factory=OffloadOptimizerConfig)
+    offload_param: OffloadParamConfig = Field(default_factory=OffloadParamConfig)
+    sub_group_size: Union[int, str] = 1_000_000_000
+    stage3_max_live_parameters: Union[int, str] = 1_000_000_000
+    stage3_max_reuse_distance: Union[int, str] = 1_000_000_000
+    stage3_prefetch_bucket_size: Union[int, str] = 50_000_000
+    stage3_param_persistence_threshold: Union[int, str] = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    #: ZeRO++-style knobs — on TPU these select quantized-collective paths
+    #: (int8 block quant allgather / hierarchical quantized grad reduce)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_hpz_partition_size: int = 1   # hpZ secondary shard group size (MiCS-like)
+    #: log a warning then ignore knobs that XLA subsumes
+    model_config = TPUConfigModel.model_config
+
+    @model_validator(mode="after")
+    def _validate_stage(self) -> "ZeroConfig":
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Parallel topology
+# ---------------------------------------------------------------------------
+
+class TensorParallelConfig(TPUConfigModel):
+    """Reference: runtime/tensor_parallel/tp_manager.py + 'autotp_size'
+    (engine.py:1020). On TPU: size of the 'model' mesh axis; parameters get
+    row/column PartitionSpecs from the AutoTP sharding planner
+    (deepspeed_tpu/parallel/tensor.py)."""
+    enabled: bool = False
+    autotp_size: int = 1
+    tp_size: int = 1
+    tp_grain_size: int = 1
+
+    @model_validator(mode="after")
+    def _merge(self) -> "TensorParallelConfig":
+        # object.__setattr__ avoids re-triggering validate_assignment
+        if self.autotp_size > 1 and self.tp_size == 1:
+            object.__setattr__(self, "tp_size", self.autotp_size)
+        if self.tp_size > 1:
+            object.__setattr__(self, "enabled", True)
+        return self
+
+
+class PipelineParallelConfig(TPUConfigModel):
+    """Reference: runtime/pipe/ (PipelineModule partitioning + 1F1B schedule).
+    On TPU: size of the 'pipe' mesh axis; stages execute under shard_map with
+    ppermute-rotated activations (deepspeed_tpu/runtime/pipe)."""
+    stages: int = 1
+    partition_method: str = "parameters"   # 'uniform' | 'parameters' | 'type:regex'
+    micro_batches: Union[int, str] = AUTO
+    activation_checkpoint_interval: int = 0
+    schedule: str = "1f1b"                 # '1f1b' | 'gpipe'
+
+
+class SequenceParallelConfig(TPUConfigModel):
+    """Reference: deepspeed/sequence (Ulysses). On TPU: 'seq' mesh axis;
+    attention uses ICI all-to-all head/sequence repartition
+    (deepspeed_tpu/parallel/ulysses.py) or ring attention
+    (deepspeed_tpu/parallel/ring.py)."""
+    size: int = 1
+    mode: str = "ulysses"  # 'ulysses' | 'ring'
+
+
+class MoEConfig(TPUConfigModel):
+    """Reference: deepspeed/moe (expert parallelism). On TPU: 'expert' mesh
+    axis; token dispatch via jax all_to_all (deepspeed_tpu/parallel/moe.py)."""
+    enabled: bool = False
+    ep_size: int = 1
+    num_experts: Union[int, List[int]] = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    aux_loss_coef: float = 0.01
+
+
+# ---------------------------------------------------------------------------
+# Aux subsystems
+# ---------------------------------------------------------------------------
+
+class CommsLoggerConfig(TPUConfigModel):
+    """Reference: comms_logger block (utils/comms_logging.py)."""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(TPUConfigModel):
+    """Reference: profiling/config.py. TPU impl uses jax AOT cost analysis
+    (compiled.cost_analysis()) instead of monkey-patching tensor ops."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(TPUConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class WandbConfig(TPUConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(TPUConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class MonitorConfig(TPUConfigModel):
+    """Reference: monitor/config.py → MonitorMaster fan-out."""
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+
+class CheckpointConfig(TPUConfigModel):
+    """Reference: checkpoint block (runtime/config.py checkpoint_config) +
+    checkpoint_engine selection. TPU default engine is orbax-backed with a
+    universal (mesh-agnostic) per-parameter fragment layout."""
+    tag_validation: str = "Warn"   # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+
+
+class DataEfficiencyConfig(TPUConfigModel):
+    """Reference: runtime/data_pipeline/config.py (curriculum etc.)."""
+    enabled: bool = False
+    seed: int = 1234
+    curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ElasticityConfig(TPUConfigModel):
+    """Reference: deepspeed/elasticity/config.py."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
+class CompressionConfig(TPUConfigModel):
+    """Reference: deepspeed/compression/config.py (subset round 1)."""
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Master config
+# ---------------------------------------------------------------------------
+
+class DeepSpeedTPUConfig(TPUConfigModel):
+    """The master config (reference: runtime/config.py:DeepSpeedConfig:651).
+
+    Batch triple resolution implemented in :meth:`resolve_batch_sizes`
+    (reference batch-size solver semantics: train_batch_size =
+    micro_batch_per_replica × gradient_accumulation_steps × dp_world_size).
+    """
+
+    train_batch_size: Union[int, str, None] = None
+    train_micro_batch_size_per_gpu: Union[int, str, None] = None
+    gradient_accumulation_steps: Union[int, str, None] = None
+
+    optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    #: dtype of cross-replica gradient reduction (reference knob
+    #: communication_data_type, stage_1_and_2.py:159)
+    communication_data_type: Optional[str] = None
+
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+    pipeline: PipelineParallelConfig = Field(default_factory=PipelineParallelConfig)
+    sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
+
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    memory_breakdown: bool = False
+    seed: int = 1234
+    #: jax debug_nans analogue of the reference's NaN/Inf sanity checks
+    check_nan_inf: bool = False
+
+    deprecated_aliases = {
+        "tensorboard": "monitor_config",
+    }
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_any(cls, config: Union[str, Dict[str, Any], "DeepSpeedTPUConfig", None]
+                 ) -> "DeepSpeedTPUConfig":
+        if config is None:
+            return cls()
+        if isinstance(config, DeepSpeedTPUConfig):
+            return config
+        if isinstance(config, str):
+            with open(config) as fh:
+                config = json.load(fh)
+        if not isinstance(config, dict):
+            raise TypeError(f"config must be a dict, json path, or "
+                            f"DeepSpeedTPUConfig, got {type(config)}")
+        config = dict(config)
+        # accept the reference's nested "monitor" keys at top level
+        monitor_keys = {}
+        for key in ("tensorboard", "wandb", "csv_monitor"):
+            if key in config:
+                monitor_keys[key] = config.pop(key)
+        if monitor_keys:
+            config.setdefault("monitor_config", {}).update(monitor_keys)
+        return cls(**config)
+
+    # -- batch triple solver -------------------------------------------------
+
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Solve train_batch = micro × gas × dp (reference
+        runtime/config.py:_batch_assertion / _set_batch_related_parameters)."""
+        tb = None if is_auto(self.train_batch_size) else self.train_batch_size
+        mb = None if is_auto(self.train_micro_batch_size_per_gpu) else \
+            self.train_micro_batch_size_per_gpu
+        gas = None if is_auto(self.gradient_accumulation_steps) else \
+            self.gradient_accumulation_steps
+
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"train_batch_size ({tb}) != micro_batch ({mb}) × "
+                    f"grad_accum ({gas}) × dp_world ({dp_world_size})")
+        elif tb is not None and mb is not None:
+            gas, rem = divmod(tb, mb * dp_world_size)
+            if rem:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by micro_batch×dp "
+                    f"{mb * dp_world_size}")
+        elif tb is not None and gas is not None:
+            mb, rem = divmod(tb, gas * dp_world_size)
+            if rem:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by gas×dp "
+                    f"{gas * dp_world_size}")
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            mb, rem = divmod(tb, dp_world_size)
+            gas = 1
+            if rem:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by dp world "
+                    f"{dp_world_size}")
+        else:
+            # reference defaults to train_batch_size=32; we default micro=1
+            mb, gas = 1, 1
+            tb = mb * gas * dp_world_size
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    # -- precision helpers ---------------------------------------------------
+
+    @property
+    def compute_dtype(self) -> str:
+        if self.fp16.enabled is True:
+            return "float16"
+        if self.bf16.enabled is True:
+            return "bfloat16"
+        # TPU-native default: bf16 unless user explicitly disabled both
+        if self.bf16.enabled is False and self.fp16.enabled is False:
+            return "float32"
+        return "bfloat16"
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0

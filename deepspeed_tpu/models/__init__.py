@@ -1,0 +1,17 @@
+from deepspeed_tpu.models.transformer import (
+    DecoderConfig,
+    cross_entropy_loss,
+    dot_product_attention,
+    forward,
+    init_params,
+    partition_specs,
+)
+from deepspeed_tpu.models.gpt import gpt2_config
+from deepspeed_tpu.models.llama import llama3_config
+from deepspeed_tpu.models.mixtral import mixtral_config
+
+__all__ = [
+    "DecoderConfig", "init_params", "forward", "partition_specs",
+    "cross_entropy_loss", "dot_product_attention",
+    "gpt2_config", "llama3_config", "mixtral_config",
+]

@@ -1,0 +1,25 @@
+from deepspeed_tpu.parallel.mesh import (
+    MESH_AXES,
+    ZERO_AXES,
+    build_mesh,
+    get_data_parallel_world_size,
+    get_expert_parallel_world_size,
+    get_mesh,
+    get_model_parallel_world_size,
+    get_pipe_parallel_world_size,
+    get_sequence_parallel_world_size,
+    get_world_size,
+    has_mesh,
+    mesh_from_config,
+    named_sharding,
+    replicated,
+    set_mesh,
+)
+
+__all__ = [
+    "MESH_AXES", "ZERO_AXES", "build_mesh", "mesh_from_config", "get_mesh",
+    "set_mesh", "has_mesh", "named_sharding", "replicated",
+    "get_data_parallel_world_size", "get_model_parallel_world_size",
+    "get_pipe_parallel_world_size", "get_sequence_parallel_world_size",
+    "get_expert_parallel_world_size", "get_world_size",
+]

@@ -1,0 +1,140 @@
+"""Device-mesh topology for deepspeed_tpu.
+
+TPU-native replacement for the reference's process-group factory
+(``deepspeed/utils/groups.py`` — ``_create_model_parallel``:191,
+``_create_expert_and_data_parallel``:240, sequence accessors:642) and the
+pipeline rank grid (``runtime/pipe/topology.py:ProcessTopology``). Instead of
+building torch.distributed groups per parallelism flavor, we build ONE
+``jax.sharding.Mesh`` whose named axes are the parallelism dimensions; every
+"group" of the reference becomes an axis name usable in PartitionSpecs and
+collectives.
+
+Axis order (outermost → innermost) is chosen for ICI locality: tensor
+('model') collectives are the most latency-sensitive so the model axis maps
+to adjacent chips; 'pipe' is outermost since pipeline P2P tolerates DCN.
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+#: canonical axis order, outermost first
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "seq", "model")
+
+#: ZeRO shards over the data axis (and the expert axis for non-expert params,
+#: since dp_world = data × expert for those — reference groups.py expert-data
+#: parallel design)
+ZERO_AXES: Tuple[str, ...] = ("data", "expert")
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def build_mesh(data: Optional[int] = None,
+               model: int = 1,
+               pipe: int = 1,
+               seq: int = 1,
+               expert: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None,
+               set_current: bool = True) -> Mesh:
+    """Build the framework mesh.
+
+    ``data=None`` infers the data-parallel degree from the device count
+    (reference analogue: world_size / (tp×pp×sp×ep)).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = model * pipe * seq * expert
+    if data is None:
+        if n % fixed:
+            raise ValueError(
+                f"device count {n} not divisible by model×pipe×seq×expert={fixed}")
+        data = n // fixed
+    total = data * fixed
+    if total != n:
+        raise ValueError(
+            f"mesh axes product {total} != device count {n} "
+            f"(pipe={pipe} data={data} expert={expert} seq={seq} model={model})")
+    arr = np.array(devices[:total]).reshape(pipe, data, expert, seq, model)
+    mesh = Mesh(arr, MESH_AXES)
+    if set_current:
+        set_mesh(mesh)
+    log_dist(f"built mesh: pipe={pipe} data={data} expert={expert} "
+             f"seq={seq} model={model}")
+    return mesh
+
+
+def mesh_from_config(config, devices=None) -> Mesh:
+    """Build a mesh from a DeepSpeedTPUConfig's parallel-topology knobs."""
+    return build_mesh(
+        model=config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1,
+        pipe=config.pipeline.stages,
+        seq=config.sequence_parallel.size,
+        expert=config.moe.ep_size if config.moe.enabled else 1,
+        devices=devices,
+    )
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_mesh() -> Mesh:
+    if _CURRENT_MESH is None:
+        raise RuntimeError("no mesh set; call build_mesh() or "
+                           "deepspeed_tpu.initialize() first")
+    return _CURRENT_MESH
+
+
+def has_mesh() -> bool:
+    return _CURRENT_MESH is not None
+
+
+# ---------------------------------------------------------------------------
+# Group accessors — API parity with reference deepspeed/utils/groups.py, but
+# returning axis names/sizes instead of torch process groups.
+# ---------------------------------------------------------------------------
+
+def _axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape[axis]
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    """DP degree for non-expert params = data × expert (reference
+    groups.py:_get_data_parallel_world_size with expert interleaving)."""
+    mesh = mesh or get_mesh()
+    return mesh.shape["data"] * mesh.shape["expert"]
+
+
+def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "model")
+
+
+def get_pipe_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "pipe")
+
+
+def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "seq")
+
+
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "expert")
+
+
+def get_world_size() -> int:
+    return jax.device_count()
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), spec)
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())

@@ -1,0 +1,5 @@
+from deepspeed_tpu.utils.logging import log_dist, logger, print_rank_0
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["logger", "log_dist", "print_rank_0",
+           "SynchronizedWallClockTimer", "ThroughputTimer"]

@@ -1,0 +1,41 @@
+"""Test harness for deepspeed_tpu.
+
+The reference simulates multi-node as multi-process on one host
+(tests/unit/common.py:DistributedExec). The TPU-native analogue is simpler:
+JAX can expose N virtual CPU devices in one process
+(``--xla_force_host_platform_device_count``), so every multi-chip sharding
+test runs single-process over an 8-device mesh. Env vars must be set before
+jax is imported, hence this module-level block.
+"""
+
+import os
+
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a real TPU
+# (axon tunnel) which must not be touched by unit tests. The tunnel's site
+# hook overrides the env var programmatically, so set the jax config knob
+# after import as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    """A flat 8-way data mesh."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    return build_mesh(data=8)

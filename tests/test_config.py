@@ -1,0 +1,107 @@
+"""Config-system tests (reference analogue: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import AUTO, DeepSpeedTPUConfig, is_auto
+
+
+def test_default_config():
+    cfg = DeepSpeedTPUConfig()
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.compute_dtype == "float32"
+    assert not cfg.zero_enabled
+
+
+def test_from_dict():
+    cfg = DeepSpeedTPUConfig.from_any({
+        "train_batch_size": 16,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 1000},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+    })
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.compute_dtype == "bfloat16"
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.zero_enabled
+
+
+def test_from_json_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_batch_size": 8,
+                                "fp16": {"enabled": True}}))
+    cfg = DeepSpeedTPUConfig.from_any(str(path))
+    assert cfg.train_batch_size == 8
+    assert cfg.compute_dtype == "float16"
+
+
+def test_batch_triple_solver():
+    # all three given, consistent
+    cfg = DeepSpeedTPUConfig.from_any({
+        "train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == (32, 2, 2)
+
+    # inconsistent
+    cfg = DeepSpeedTPUConfig.from_any({
+        "train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 4})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+    # derive gas
+    cfg = DeepSpeedTPUConfig.from_any({
+        "train_batch_size": 64, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+    # derive train_batch
+    cfg = DeepSpeedTPUConfig.from_any({"train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+    # derive micro from tb alone
+    cfg = DeepSpeedTPUConfig.from_any({"train_batch_size": 16})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+    # auto values treated as unset
+    cfg = DeepSpeedTPUConfig.from_any({
+        "train_batch_size": AUTO, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": AUTO})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 16
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(Exception):
+        DeepSpeedTPUConfig.from_any({"zero_optimization": {"stage": 7}})
+
+
+def test_offload_config():
+    cfg = DeepSpeedTPUConfig.from_any({
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+        }})
+    assert cfg.zero_optimization.offload_optimizer.device.value == "cpu"
+    assert cfg.zero_optimization.offload_param.device.value == "nvme"
+
+
+def test_tp_autotp_merge():
+    cfg = DeepSpeedTPUConfig.from_any({"tensor_parallel": {"autotp_size": 4}})
+    assert cfg.tensor_parallel.tp_size == 4
+    assert cfg.tensor_parallel.enabled
+
+
+def test_is_auto():
+    assert is_auto("auto") and is_auto("AUTO")
+    assert not is_auto(4) and not is_auto("x")
