@@ -1,0 +1,161 @@
+"""Checkpoint store — universal by construction.
+
+Reference: engine save_checkpoint/load_checkpoint (runtime/engine.py:3621,
+3273), the pluggable CheckpointEngine ABC
+(runtime/checkpoint_engine/checkpoint_engine.py:21), and Universal
+Checkpoint (deepspeed/checkpoint/ds_to_universal.py). The reference writes
+per-rank partitioned shards and needs an offline converter to reshape
+across (TP,PP,DP) changes; here every leaf is written **once, full-shape**
+(gathered from its mesh sharding on save, resharded by ``device_put`` on
+load), so *any* later mesh/ZeRO-stage reload works with no conversion —
+the UCP property is the default.
+
+Layout::
+
+    <dir>/<tag>/meta.json             # counters + optimizer hyperparams
+    <dir>/<tag>/state/<group>/<leaf-path>.npy
+    <dir>/latest                      # text file with the newest tag
+
+Multi-host note: round 1 gathers to the host of process 0; a sharded
+multi-host writer (per-fragment files + index, Orbax-style) is the
+follow-on once multi-process checkpointing is exercised.
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+_SEP = "."
+
+
+def _leaf_paths(tree) -> Dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = _SEP.join(_path_str(k) for k in path)
+        out[key] = leaf
+    return out
+
+
+def _path_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
+                    meta: Dict[str, Any], save_latest: bool = True) -> str:
+    """Write ``state`` (dict of named pytrees) + ``meta`` under tag."""
+    root = os.path.join(save_dir, tag)
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    os.makedirs(os.path.join(root, "state"), exist_ok=True)
+    index: Dict[str, Dict[str, Any]] = {}
+    for group, tree in state.items():
+        gdir = os.path.join(root, "state", group)
+        os.makedirs(gdir, exist_ok=True)
+        for key, leaf in _leaf_paths(tree).items():
+            arr = np.asarray(jax.device_get(leaf))
+            orig_dtype = str(arr.dtype)
+            # npy can't round-trip ml_dtypes (bfloat16/fp8): widen to fp32
+            # on disk, record the original dtype for exact reload
+            if arr.dtype.kind not in "fiub?" or orig_dtype == "bfloat16":
+                arr = arr.astype(np.float32)
+            fname = key.replace("/", "_") + ".npy"
+            np.save(os.path.join(gdir, fname), arr)
+            index.setdefault(group, {})[key] = {
+                "file": fname, "shape": list(arr.shape),
+                "dtype": orig_dtype}
+    with open(os.path.join(root, "meta.json"), "w") as fh:
+        json.dump({"meta": meta, "index": index}, fh, indent=1)
+    if save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as fh:
+            fh.write(tag)
+    return root
+
+
+def latest_tag(load_dir: str) -> Optional[str]:
+    path = os.path.join(load_dir, "latest")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return fh.read().strip()
+
+
+def load_checkpoint(load_dir: str, tag: Optional[str],
+                    templates: Dict[str, Pytree],
+                    shardings: Dict[str, Pytree]
+                    ) -> Tuple[Optional[Dict[str, Pytree]],
+                               Dict[str, Any], Optional[str]]:
+    """Load state matching ``templates`` structure, placing each leaf with
+    the corresponding sharding (any mesh — this is the universal reshape)."""
+    tag = tag or latest_tag(load_dir)
+    if tag is None:
+        return None, {}, None
+    root = os.path.join(load_dir, tag)
+    meta_path = os.path.join(root, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint at {root}")
+    with open(meta_path) as fh:
+        payload = json.load(fh)
+    meta = payload["meta"]
+
+    out: Dict[str, Pytree] = {}
+    for group, template in templates.items():
+        gdir = os.path.join(root, "state", group)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        sh_leaves = jax.tree_util.tree_leaves(
+            shardings[group], is_leaf=lambda x: hasattr(x, "mesh"))
+        if len(sh_leaves) != len(flat):
+            # sharding tree may mirror template exactly; flatten generally
+            sh_flat, _ = jax.tree_util.tree_flatten_with_path(
+                shardings[group], is_leaf=lambda x: hasattr(x, "mesh"))
+            sh_leaves = [leaf for _, leaf in sh_flat]
+        leaves = []
+        for (path, tmpl), sh in zip(flat, sh_leaves):
+            key = _SEP.join(_path_str(k) for k in path)
+            fname = os.path.join(gdir, key.replace("/", "_") + ".npy")
+            arr = jnp.asarray(np.load(fname))
+            tdtype = jnp.asarray(tmpl).dtype
+            if arr.dtype != tdtype:
+                arr = arr.astype(tdtype)
+            leaves.append(jax.device_put(arr, sh))
+        out[group] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return out, meta, tag
+
+
+def consolidate_to_fp32(load_dir: str, tag: Optional[str] = None
+                        ) -> Dict[str, np.ndarray]:
+    """Offline merge to fp32 state dict (reference
+    utils/zero_to_fp32.py:188) — trivially: read the master (or params)
+    leaves back as fp32 numpy arrays without any runtime."""
+    tag = tag or latest_tag(load_dir)
+    root = os.path.join(load_dir, tag)
+    with open(os.path.join(root, "meta.json")) as fh:
+        payload = json.load(fh)
+    index = payload["index"]
+    src = "params"
+    master_keys = {k: v for k, v in index.get("opt_state", {}).items()
+                   if k.startswith("master" + _SEP)}
+    out = {}
+    if master_keys:
+        for key, entry in master_keys.items():
+            arr = np.load(os.path.join(root, "state", "opt_state",
+                                       entry["file"]))
+            out[key[len("master" + _SEP):]] = arr.astype(np.float32)
+    else:
+        for key, entry in index.get(src, {}).items():
+            arr = np.load(os.path.join(root, "state", src, entry["file"]))
+            out[key] = arr.astype(np.float32)
+    return out

@@ -1,0 +1,112 @@
+"""Experiment monitoring fan-out.
+
+Reference: deepspeed/monitor/monitor.py:30 (MonitorMaster → TensorBoard /
+W&B / CSV writers; events written from engine.py:2822). Same fan-out
+design; writers degrade to no-ops when their backend isn't installed.
+Events are ``(name, value, step)`` triples.
+"""
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class _Writer:
+    enabled = False
+
+    def write_events(self, events: List[Event]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(_Writer):
+    """Reference monitor/tensorboard.py."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            out = os.path.join(cfg.output_path or "runs", cfg.job_name)
+            self.writer = SummaryWriter(log_dir=out)
+            self.enabled = True
+        except Exception as exc:
+            logger.warning(f"tensorboard monitor disabled: {exc}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(_Writer):
+    """Reference monitor/wandb.py."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            import wandb
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+            self.wandb = wandb
+            self.enabled = True
+        except Exception as exc:
+            logger.warning(f"wandb monitor disabled: {exc}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.wandb.log({name: value}, step=step)
+
+
+class CSVMonitor(_Writer):
+    """Reference monitor/csv_monitor.py — one csv per metric name."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        self.dir = os.path.join(cfg.output_path or "csv_monitor", cfg.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.dir,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(_Writer):
+    """Reference monitor/monitor.py:MonitorMaster — rank-0 fan-out."""
+
+    def __init__(self, monitor_config):
+        import jax
+        self._is_rank0 = jax.process_index() == 0
+        self.writers: List[_Writer] = []
+        if self._is_rank0:
+            for w in (TensorBoardMonitor(monitor_config.tensorboard),
+                      WandbMonitor(monitor_config.wandb),
+                      CSVMonitor(monitor_config.csv_monitor)):
+                if w.enabled:
+                    self.writers.append(w)
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events: List[Event]) -> None:
+        for w in self.writers:
+            w.write_events(events)

@@ -1,0 +1,463 @@
+"""TPU-native optimizer library.
+
+Replaces the reference's fused/CPU optimizer kernels
+(csrc/adam/multi_tensor_adam.cu + ops/adam/fused_adam.py:18,
+csrc/lamb/fused_lamb_cuda.cu + ops/lamb/fused_lamb.py:14,
+csrc/lion/multi_tensor_lion.cu + ops/lion/fused_lion.py:17,
+csrc/adagrad/cpu_adagrad.cpp, runtime/zero/muon/muon_optimizer.py:14).
+
+Design: each optimizer is an ``Optimizer(init, update)`` pair over a pytree
+of parameters. ``update`` consumes grads and a scalar ``lr`` and returns the
+*new params* plus new state — not optax-style "updates" — because mixed
+precision is first-class: when params are bf16, the state carries an fp32
+master copy (the reference's flat fp32 partitions,
+runtime/bf16_optimizer.py:35) and the math runs on the master, with a cast
+back to the compute dtype at the end. XLA fuses the whole sweep into a few
+elementwise kernels over each buffer — the multi-tensor-apply machinery of
+the CUDA path is unnecessary.
+
+Everything here is jit-compatible and shape-polymorphic over the pytree, so
+the same code runs replicated (ZeRO-0), with sharded state (ZeRO-1/2), or
+fully sharded (ZeRO-3) purely by virtue of the shardings the engine installs
+on ``state``.
+"""
+
+import math
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+OptState = Dict[str, Any]
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Params], OptState]
+    update: Callable[[Params, OptState, Params, jax.Array], Tuple[Params, OptState]]
+    #: static metadata (name, hyperparams) for checkpointing
+    hyperparams: Dict[str, Any]
+
+
+def _to_f32(t):
+    return jax.tree.map(lambda x: x.astype(jnp.float32), t)
+
+
+def _needs_master(params) -> bool:
+    return any(x.dtype != jnp.float32 for x in jax.tree.leaves(params))
+
+
+def _zeros_like_f32(params):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def _get_master(state: OptState, params: Params) -> Params:
+    """fp32 view of the weights: master copy if present, else params."""
+    return state["master"] if "master" in state else params
+
+
+def _finish(state: OptState, new_master: Params, params: Params,
+            new_inner: Dict[str, Any]) -> Tuple[Params, OptState]:
+    """Cast master back to compute dtype and rebuild state."""
+    new_params = jax.tree.map(
+        lambda m, p: m.astype(p.dtype), new_master, params)
+    out = dict(state)
+    out.update(new_inner)
+    if "master" in state:
+        out["master"] = new_master
+    return new_params, out
+
+
+# ---------------------------------------------------------------------------
+# Adam / AdamW  (reference ops/adam/fused_adam.py:18 — adam_w_mode flag)
+# ---------------------------------------------------------------------------
+
+def adam(beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+         weight_decay: float = 0.0, adam_w_mode: bool = True,
+         bias_correction: bool = True) -> Optimizer:
+    hp = dict(name="adamw" if adam_w_mode else "adam", beta1=beta1,
+              beta2=beta2, eps=eps, weight_decay=weight_decay,
+              adam_w_mode=adam_w_mode, bias_correction=bias_correction)
+
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "exp_avg": _zeros_like_f32(params),
+                 "exp_avg_sq": _zeros_like_f32(params)}
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(m, v, g, p):
+            if weight_decay and not adam_w_mode:
+                g = g + weight_decay * p
+            m = beta1 * m + (1 - beta1) * g
+            v = beta2 * v + (1 - beta2) * (g * g)
+            mhat = m / bc1
+            vhat = v / bc2
+            upd = mhat / (jnp.sqrt(vhat) + eps)
+            if weight_decay and adam_w_mode:
+                upd = upd + weight_decay * p
+            return m, v, p - lr * upd
+
+        flat = jax.tree.map(leaf, state["exp_avg"], state["exp_avg_sq"],
+                            g32, master)
+        new_m = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_master = jax.tree.map(lambda t: t[2], flat,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        return _finish(state, new_master, params,
+                       {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v})
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# LAMB  (reference ops/lamb/fused_lamb.py:14 — layerwise trust ratio)
+# ---------------------------------------------------------------------------
+
+def lamb(beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-6,
+         weight_decay: float = 0.0, max_coeff: float = 10.0,
+         min_coeff: float = 0.01) -> Optimizer:
+    hp = dict(name="lamb", beta1=beta1, beta2=beta2, eps=eps,
+              weight_decay=weight_decay, max_coeff=max_coeff,
+              min_coeff=min_coeff)
+
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "exp_avg": _zeros_like_f32(params),
+                 "exp_avg_sq": _zeros_like_f32(params)}
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+
+        def leaf(m, v, g, p):
+            m = beta1 * m + (1 - beta1) * g
+            v = beta2 * v + (1 - beta2) * (g * g)
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+            return m, v, p - lr * trust * upd
+
+        flat = jax.tree.map(leaf, state["exp_avg"], state["exp_avg_sq"],
+                            g32, master)
+        new_m = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_master = jax.tree.map(lambda t: t[2], flat,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        return _finish(state, new_master, params,
+                       {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v})
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# Lion  (reference ops/lion/fused_lion.py:17)
+# ---------------------------------------------------------------------------
+
+def lion(beta1: float = 0.9, beta2: float = 0.99,
+         weight_decay: float = 0.0) -> Optimizer:
+    hp = dict(name="lion", beta1=beta1, beta2=beta2,
+              weight_decay=weight_decay)
+
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "exp_avg": _zeros_like_f32(params)}
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+
+        def leaf(m, g, p):
+            upd = jnp.sign(beta1 * m + (1 - beta1) * g)
+            if weight_decay:
+                p = p * (1 - lr * weight_decay)
+            m = beta2 * m + (1 - beta2) * g
+            return m, p - lr * upd
+
+        flat = jax.tree.map(leaf, state["exp_avg"], g32, master)
+        new_m = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_master = jax.tree.map(lambda t: t[1], flat,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        return _finish(state, new_master, params,
+                       {"step": step, "exp_avg": new_m})
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# Adagrad  (reference csrc/adagrad/cpu_adagrad.cpp)
+# ---------------------------------------------------------------------------
+
+def adagrad(eps: float = 1e-10, weight_decay: float = 0.0) -> Optimizer:
+    hp = dict(name="adagrad", eps=eps, weight_decay=weight_decay)
+
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "sum_sq": _zeros_like_f32(params)}
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+
+        def leaf(s, g, p):
+            if weight_decay:
+                g = g + weight_decay * p
+            s = s + g * g
+            return s, p - lr * g / (jnp.sqrt(s) + eps)
+
+        flat = jax.tree.map(leaf, state["sum_sq"], g32, master)
+        new_s = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_master = jax.tree.map(lambda t: t[1], flat,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        return _finish(state, new_master, params,
+                       {"step": step, "sum_sq": new_s})
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# SGD (+momentum) — reference falls back to torch.optim.SGD
+# ---------------------------------------------------------------------------
+
+def sgd(momentum: float = 0.0, weight_decay: float = 0.0,
+        nesterov: bool = False) -> Optimizer:
+    hp = dict(name="sgd", momentum=momentum, weight_decay=weight_decay,
+              nesterov=nesterov)
+
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if momentum:
+            state["momentum"] = _zeros_like_f32(params)
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+        new_inner: Dict[str, Any] = {"step": step}
+        if weight_decay:
+            g32 = jax.tree.map(lambda g, p: g + weight_decay * p, g32, master)
+        if momentum:
+            buf = jax.tree.map(lambda b, g: momentum * b + g,
+                               state["momentum"], g32)
+            new_inner["momentum"] = buf
+            if nesterov:
+                g32 = jax.tree.map(lambda g, b: g + momentum * b, g32, buf)
+            else:
+                g32 = buf
+        new_master = jax.tree.map(lambda p, g: p - lr * g, master, g32)
+        return _finish(state, new_master, params, new_inner)
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# Muon  (reference runtime/zero/muon/muon_optimizer.py:14,
+#        original_muon.py:36–267 — Newton–Schulz orthogonalized momentum on
+#        2-D weights, Adam for the rest)
+# ---------------------------------------------------------------------------
+
+def _newton_schulz(G: jax.Array, steps: int = 5, eps: float = 1e-7) -> jax.Array:
+    """Quintic Newton–Schulz iteration approximating UV^T of G = USV^T.
+
+    Coefficients per the public Muon recipe (reference
+    original_muon.py:zeropower_via_newtonschulz5). Runs in bf16 on the MXU.
+    """
+    a, b, c = 3.4445, -4.7750, 2.0315
+    transpose = G.shape[0] > G.shape[1]
+    X = G.astype(jnp.bfloat16)
+    if transpose:
+        X = X.T
+    X = X / (jnp.linalg.norm(X.astype(jnp.float32)) + eps).astype(jnp.bfloat16)
+    for _ in range(steps):
+        A = X @ X.T
+        B = b * A + c * (A @ A)
+        X = a * X + B @ X
+    if transpose:
+        X = X.T
+    return X
+
+
+def muon(beta: float = 0.95, weight_decay: float = 0.0, ns_steps: int = 5,
+         adam_beta1: float = 0.9, adam_beta2: float = 0.999,
+         adam_eps: float = 1e-8) -> Optimizer:
+    """2-D weight matrices get orthogonalized momentum; everything else
+    (embeddings are excluded in the reference by the user; here: non-2D
+    leaves and leaves whose path mentions 'embed'/'norm'/'bias') gets Adam.
+
+    Stacked-layer 3-D weights [L, in, out] are treated as L independent 2-D
+    matrices via vmap — matching per-layer semantics of the reference while
+    keeping the scan-stacked layout.
+    """
+    hp = dict(name="muon", beta=beta, weight_decay=weight_decay,
+              ns_steps=ns_steps)
+
+    def _is_muon_leaf(path: str, x) -> bool:
+        if x.ndim < 2:
+            return False
+        lowered = path.lower()
+        return not any(k in lowered for k in ("embed", "norm", "bias", "lm_head"))
+
+    def _mask(params):
+        # Static: derived from the pytree *structure*, never from traced values.
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        return [_is_muon_leaf("/".join(str(k) for k in path), x)
+                for path, x in flat]
+
+    def init(params):
+        # per-leaf state only where the update reads it: momentum for Muon
+        # leaves, Adam moments for the rest (scalar placeholders elsewhere
+        # keep pytree structure aligned without burning HBM)
+        mask = _mask(params)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+
+        def select(keep):
+            leaves = [jnp.zeros(p.shape, jnp.float32) if k == keep
+                      else jnp.zeros((), jnp.float32)
+                      for p, k in zip(flat, mask)]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "momentum": select(True),
+                 "exp_avg": select(False),
+                 "exp_avg_sq": select(False)}
+        if _needs_master(params):
+            state["master"] = _to_f32(params)
+        return state
+
+    def update(grads, state, params, lr):
+        step = state["step"] + 1
+        master = _get_master(state, params)
+        g32 = _to_f32(grads)
+        mask = _mask(params)
+        bc1 = 1.0 - adam_beta1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - adam_beta2 ** step.astype(jnp.float32)
+
+        leaves_g, treedef = jax.tree_util.tree_flatten(g32)
+        leaves_p = treedef.flatten_up_to(master)
+        leaves_mom = treedef.flatten_up_to(state["momentum"])
+        leaves_m = treedef.flatten_up_to(state["exp_avg"])
+        leaves_v = treedef.flatten_up_to(state["exp_avg_sq"])
+
+        out_p, out_mom, out_m, out_v = [], [], [], []
+        for is_muon, g, p, mom, m, v in zip(mask, leaves_g, leaves_p,
+                                            leaves_mom, leaves_m, leaves_v):
+            if is_muon:
+                mom = beta * mom + g
+                eff = g + beta * mom   # nesterov-style
+                mat = eff
+                if mat.ndim == 2:
+                    ortho = _newton_schulz(mat, ns_steps)
+                else:
+                    flat2d = mat.reshape(mat.shape[0], mat.shape[1], -1)
+                    ortho = jax.vmap(lambda x: _newton_schulz(x, ns_steps))(flat2d)
+                    ortho = ortho.reshape(mat.shape)
+                scale = math.sqrt(max(1.0, mat.shape[-2] / mat.shape[-1]))
+                upd = ortho.astype(jnp.float32) * scale
+                if weight_decay:
+                    upd = upd + weight_decay * p
+                out_p.append(p - lr * upd)
+                out_mom.append(mom)
+                out_m.append(m)
+                out_v.append(v)
+            else:
+                m = adam_beta1 * m + (1 - adam_beta1) * g
+                v = adam_beta2 * v + (1 - adam_beta2) * (g * g)
+                upd = (m / bc1) / (jnp.sqrt(v / bc2) + adam_eps)
+                if weight_decay:
+                    upd = upd + weight_decay * p
+                out_p.append(p - lr * upd)
+                out_mom.append(mom)
+                out_m.append(m)
+                out_v.append(v)
+
+        new_master = jax.tree_util.tree_unflatten(treedef, out_p)
+        new_inner = {"step": step,
+                     "momentum": jax.tree_util.tree_unflatten(treedef, out_mom),
+                     "exp_avg": jax.tree_util.tree_unflatten(treedef, out_m),
+                     "exp_avg_sq": jax.tree_util.tree_unflatten(treedef, out_v)}
+        return _finish(state, new_master, params, new_inner)
+
+    return Optimizer(init, update, hp)
+
+
+# ---------------------------------------------------------------------------
+# Registry — reference engine.py:_configure_basic_optimizer:1541 name dispatch
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Callable[..., Optimizer]] = {}
+
+
+def register_optimizer(name: str, factory: Callable[..., Optimizer]) -> None:
+    _REGISTRY[name.lower()] = factory
+
+
+for _n, _f in [("adam", lambda **kw: adam(**{"adam_w_mode": False, **kw})),
+               ("adamw", adam),
+               ("fusedadam", adam),
+               ("lamb", lamb),
+               ("lion", lion),
+               ("adagrad", adagrad),
+               ("sgd", sgd),
+               ("muon", muon)]:
+    register_optimizer(_n, _f)
+
+#: torch-style param names accepted in config "params" blocks, mapped to ours
+_PARAM_ALIASES = {
+    "lr": None,              # handled by the engine/scheduler, not the optimizer
+    "betas": ("beta1", "beta2"),
+    "bias_correction": "bias_correction",
+}
+
+
+def build_optimizer(name: str, params: Optional[Dict[str, Any]] = None) -> Tuple[Optimizer, float]:
+    """Build from a config block (reference "optimizer": {"type","params"}).
+
+    Returns (optimizer, base_lr) — lr is owned by the LR schedule.
+    """
+    params = dict(params or {})
+    base_lr = float(params.pop("lr", 1e-3))
+    betas = params.pop("betas", None)
+    if betas is not None:
+        params["beta1"], params["beta2"] = float(betas[0]), float(betas[1])
+    params.pop("torch_adam", None)
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown optimizer '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**params), base_lr

@@ -1,0 +1,101 @@
+"""Distributed dataloader.
+
+Reference: runtime/dataloader.py (DeepSpeedDataLoader with
+DistributedSampler) + engine.deepspeed_io:2035. TPU-native difference: one
+process drives all local devices, so the loader yields **global**
+microbatches of size micro_batch × dp_world; the engine shards the batch
+dim over the DP mesh axes on device_put. Single-process scope for now:
+multi-host loading (per-process slices assembled via
+``jax.make_array_from_process_local_data``) is a planned follow-on and is
+NOT yet implemented here.
+"""
+
+import math
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class DeepSpeedTPUDataLoader:
+    """Iterate a map-style dataset (indexable, len()) as global microbatches.
+
+    Items may be dicts of arrays or tuples (input_ids, labels). A
+    ``collate_fn`` may override batching.
+    """
+
+    def __init__(self, dataset, micro_batch_size: int, dp_world_size: int,
+                 seed: int = 0, shuffle: bool = True, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.micro_batch_size = micro_batch_size
+        self.dp_world_size = dp_world_size
+        self.global_batch = micro_batch_size * dp_world_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.epoch = 0
+        if len(dataset) < self.global_batch:
+            raise ValueError(
+                f"dataset of {len(dataset)} items smaller than one global "
+                f"microbatch ({self.global_batch})")
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.global_batch
+        if not self.drop_last and len(self.dataset) % self.global_batch:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        usable = len(order) - (len(order) % self.global_batch
+                               if self.drop_last else 0)
+        for start in range(0, usable, self.global_batch):
+            idx = order[start:start + self.global_batch]
+            if len(idx) < self.global_batch:
+                if self.drop_last:
+                    return
+                # pad by wrapping (keeps static shapes for jit)
+                idx = np.concatenate(
+                    [idx, order[:self.global_batch - len(idx)]])
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+def _default_collate(items: Sequence[Any]) -> Dict[str, np.ndarray]:
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(it[k]) for it in items])
+                for k in first}
+    if isinstance(first, (tuple, list)):
+        names = ["input_ids", "labels"][:len(first)]
+        return {n: np.stack([np.asarray(it[i]) for it in items])
+                for i, n in enumerate(names)}
+    return {"input_ids": np.stack([np.asarray(it) for it in items])}
+
+
+class RepeatingLoader:
+    """Reference runtime/dataloader.py:RepeatingLoader — wrap a loader to
+    restart (epoch++) when exhausted."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self._iter = iter(self.loader)
+            return next(self._iter)

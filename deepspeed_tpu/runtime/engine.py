@@ -1,0 +1,564 @@
+"""The deepspeed_tpu training engine.
+
+TPU-native re-design of the reference's ``DeepSpeedEngine``
+(runtime/engine.py:206) and ``deepspeed.initialize``
+(deepspeed/__init__.py:78). The reference wraps a torch module and drives
+training through gradient hooks, flat fp16 partitions, and a hand-built
+collective schedule. Here the engine owns:
+
+- a **functional model spec** (init/loss pair over a params pytree),
+- a **ZeRO sharding plan** (runtime/zero/sharding.py) mapping stage 0–3 to
+  param/grad/optimizer-state shardings over the mesh,
+- **one jitted train step** — forward, backward, (fp16 unscale/overflow),
+  global-norm clip, optimizer update, LR schedule — donated in-place; XLA
+  emits the reduce-scatter / allgather pattern of the corresponding ZeRO
+  stage from the sharding annotations alone,
+- GAS accounting (`forward`/`backward`/`step` parity API plus the fused
+  `train_batch` fast path with a `lax.scan` over microbatches),
+- checkpointing, monitoring, throughput timing.
+
+API parity map (reference runtime/engine.py):
+  forward:2222  backward:2478  step:2653  train_batch (pipe engine:337)
+  save_checkpoint:3621  load_checkpoint:3273
+"""
+
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.config import DeepSpeedTPUConfig
+from deepspeed_tpu.ops.optimizers import Optimizer, build_optimizer
+from deepspeed_tpu.parallel.mesh import (ZERO_AXES, build_mesh,
+                                         get_data_parallel_world_size,
+                                         has_mesh, get_mesh, mesh_from_config)
+from deepspeed_tpu.runtime.loss_scaler import (LossScaleState, check_overflow,
+                                               init_loss_scale, update_scale)
+from deepspeed_tpu.runtime.lr_schedules import Schedule, build_schedule
+from deepspeed_tpu.runtime.zero.sharding import ZeroShardingPlan
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+#: loss_fn(params, batch, rng) -> loss | (loss, metrics-dict)
+LossFn = Callable[[Pytree, Batch, jax.Array], Any]
+
+
+@dataclass
+class ModelSpec:
+    """Functional model contract consumed by the engine.
+
+    The TPU answer to "pass a torch.nn.Module": parameters are an explicit
+    pytree; ``loss_fn`` is pure; ``partition_specs`` carries the model's
+    tensor-parallel/FSDP layout (the AutoTP + zero.Init analogue)."""
+    init_fn: Callable[[jax.Array], Pytree]
+    loss_fn: LossFn
+    #: base PartitionSpec pytree (TP and, for stage 3, FSDP axes); None →
+    #: fully replicated base
+    partition_specs: Optional[Pytree] = None
+    #: approximate FLOPs per token for MFU reporting (6*N for dense decoders)
+    flops_per_token: Optional[float] = None
+    #: tokens per sample (seq len) for throughput accounting
+    tokens_per_sample: Optional[int] = None
+
+
+class DeepSpeedTPUEngine:
+    """See module docstring. Construct via :func:`initialize`."""
+
+    def __init__(self,
+                 model: ModelSpec,
+                 config: DeepSpeedTPUConfig,
+                 mesh: Optional[Mesh] = None,
+                 params: Optional[Pytree] = None,
+                 rng: Optional[jax.Array] = None,
+                 training_data=None):
+        comm.init_distributed()
+        self.model = model
+        self.config = config
+        self.mesh = mesh or (get_mesh() if has_mesh() else mesh_from_config(config))
+        self.dp_world_size = get_data_parallel_world_size(self.mesh)
+        config.resolve_batch_sizes(self.dp_world_size)
+
+        self.zero_stage = config.zero_optimization.stage
+        self.fp16_enabled = config.fp16.enabled is True
+        self.bf16_enabled = (config.bf16.enabled is True or
+                             (not self.fp16_enabled and
+                              config.compute_dtype == "bfloat16"))
+        self.compute_dtype = {"float16": jnp.float16,
+                              "bfloat16": jnp.bfloat16,
+                              "float32": jnp.float32}[config.compute_dtype]
+
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.global_samples = 0
+
+        # -- optimizer & schedule ------------------------------------------
+        self.optimizer, base_lr = build_optimizer(
+            config.optimizer.type, config.optimizer.params)
+        self.lr_schedule: Schedule = build_schedule(
+            config.scheduler.type, config.scheduler.params, base_lr)
+
+        # -- params (sharded at init — the zero.Init analogue) -------------
+        rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+        self._init_params_and_state(params, rng)
+
+        # -- loss scaling ---------------------------------------------------
+        self.loss_scale_state = init_loss_scale(
+            config.fp16.loss_scale, config.fp16.initial_scale_power,
+            config.fp16.hysteresis) if self.fp16_enabled else \
+            LossScaleState(jnp.float32(1.0), jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.int32))
+        self.dynamic_loss_scale = self.fp16_enabled and config.fp16.loss_scale == 0
+
+        # -- jitted functions ----------------------------------------------
+        self._build_step_functions()
+
+        # -- grad accumulation buffers -------------------------------------
+        self._acc_grads: Optional[Pytree] = None
+        self._acc_count = 0
+        self._pending_loss = None
+
+        # -- aux ------------------------------------------------------------
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=int(self.config.train_batch_size),
+            steps_per_output=config.steps_per_print)
+        self.monitor = self._build_monitor()
+        self.training_dataloader = self._build_dataloader(training_data)
+        self.lr_scheduler = self.lr_schedule   # parity name
+
+        log_dist(
+            f"engine ready: zero_stage={self.zero_stage} dtype="
+            f"{config.compute_dtype} dp={self.dp_world_size} "
+            f"micro_batch={config.train_micro_batch_size_per_gpu} "
+            f"gas={config.gradient_accumulation_steps} "
+            f"train_batch={config.train_batch_size}")
+
+    # ------------------------------------------------------------------ init
+
+    def _base_specs(self) -> Pytree:
+        if self.model.partition_specs is not None:
+            return self.model.partition_specs
+        # fully replicated base layout matching the params structure
+        return jax.tree.map(lambda p: P(*([None] * np.ndim(p))),
+                            self._abstract_params)
+
+    def _init_params_and_state(self, params: Optional[Pytree],
+                               rng: jax.Array) -> None:
+        dtype = self.compute_dtype
+
+        def cast_init(r):
+            p = self.model.init_fn(r)
+            if dtype == jnp.float32:
+                return p
+            # cast the whole model to the compute dtype (reference
+            # engine.py:_configure_distributed_model half conversion)
+            return jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+        self._abstract_params = jax.eval_shape(cast_init, rng)
+        base_specs = self._base_specs()
+        self.plan = ZeroShardingPlan(self.mesh, self.zero_stage, base_specs,
+                                     self._abstract_params)
+        param_sh = self.plan.param_shardings()
+        if params is None:
+            init_jit = jax.jit(cast_init, out_shardings=param_sh)
+            self.params = init_jit(rng)
+        else:
+            self.params = jax.device_put(
+                jax.tree.map(lambda x: x.astype(dtype)
+                             if jnp.issubdtype(x.dtype, jnp.floating) and
+                             dtype != jnp.float32 else x, params), param_sh)
+        abstract_state = jax.eval_shape(self.optimizer.init, self.params)
+        state_sh = self.plan.opt_state_shardings(abstract_state)
+        self.opt_state = jax.jit(self.optimizer.init,
+                                 out_shardings=state_sh)(self.params)
+        self._state_shardings = state_sh
+        self._param_shardings = param_sh
+
+    # ------------------------------------------------------------- jit build
+
+    def _batch_sharding(self, batch_like) -> Pytree:
+        """Shard batch dim over DP axes (and seq dim over 'seq' if SP>1)."""
+        sp = self.mesh.shape["seq"] > 1
+
+        def spec_for(x):
+            nd = np.ndim(x)
+            if nd == 0:
+                return NamedSharding(self.mesh, P())
+            entries = [ZERO_AXES] + [None] * (nd - 1)
+            if sp and nd >= 2:
+                entries[1] = "seq"
+            return NamedSharding(self.mesh, P(*entries))
+        return jax.tree.map(spec_for, batch_like)
+
+    def _compute_loss_and_grads(self, params, batch, scale, rng):
+        def scaled_loss(p):
+            out = self.model.loss_fn(p, batch, rng)
+            loss, metrics = (out if isinstance(out, tuple) else (out, {}))
+            return loss * scale, (loss, metrics)
+        grads, (loss, metrics) = jax.grad(scaled_loss, has_aux=True)(params)
+        grads = jax.lax.with_sharding_constraint(
+            grads, self.plan.grad_shardings())
+        return loss, metrics, grads
+
+    def _apply_update(self, params, opt_state, scaler, grads, step, gas):
+        cfg = self.config
+        inv = 1.0 / (scaler.scale * gas)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        overflow = check_overflow(grads) if self.fp16_enabled else \
+            jnp.zeros((), bool)
+        # global grad norm (reference get_global_norm + clip_grad_norm_)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in jax.tree.leaves(grads))
+        grad_norm = jnp.sqrt(sq)
+        if cfg.gradient_clipping > 0:
+            clip = jnp.minimum(1.0, cfg.gradient_clipping /
+                               (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * clip, grads)
+        lr = self.lr_schedule(step)
+        new_params, new_opt = self.optimizer.update(
+            grads, opt_state, params, lr)
+        if self.fp16_enabled:
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new_params, params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
+            scaler = update_scale(
+                scaler, overflow, dynamic=self.dynamic_loss_scale,
+                scale_window=cfg.fp16.loss_scale_window,
+                min_scale=cfg.fp16.min_loss_scale,
+                delayed_shift=cfg.fp16.hysteresis,
+                consecutive_hysteresis=cfg.fp16.consecutive_hysteresis)
+        new_params = jax.lax.with_sharding_constraint(
+            new_params, self._param_shardings)
+        metrics = {"lr": lr, "grad_norm": grad_norm,
+                   "loss_scale": scaler.scale,
+                   "overflow": overflow.astype(jnp.int32)}
+        return new_params, new_opt, scaler, metrics
+
+    def _build_step_functions(self) -> None:
+        gas = int(self.config.gradient_accumulation_steps)
+
+        # fused train_batch step: batch leaves have leading [gas, ...] dim
+        def fused_step(params, opt_state, scaler, batch, step, rng):
+            def micro(carry, mb):
+                acc, r = carry
+                r, sub = jax.random.split(r)
+                loss, _m, grads = self._compute_loss_and_grads(
+                    params, mb, scaler.scale, sub)
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, r), loss
+
+            if gas == 1:
+                mb = jax.tree.map(lambda x: x[0], batch)
+                rng, sub = jax.random.split(rng)
+                loss, _m, acc = self._compute_loss_and_grads(
+                    params, mb, scaler.scale, sub)
+                losses = loss[None]
+            else:
+                # accumulate in fp32 over microbatches (reference knob
+                # gradient_accumulation_dtype); the accumulator carries the
+                # grad shardings so ZeRO-2+ keeps it scattered across steps
+                zero = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zero = jax.lax.with_sharding_constraint(
+                    zero, self.plan.grad_shardings())
+                (acc, rng), losses = jax.lax.scan(micro, (zero, rng), batch)
+            params, opt_state, scaler, metrics = self._apply_update(
+                params, opt_state, scaler, acc, step, gas)
+            metrics["loss"] = jnp.mean(losses)
+            return params, opt_state, scaler, metrics
+
+        self._fused_step = jax.jit(
+            fused_step, donate_argnums=(0, 1, 2),
+            static_argnames=())
+
+        # parity API pieces
+        def grad_step(params, batch, scale, rng):
+            loss, metrics, grads = self._compute_loss_and_grads(
+                params, batch, scale, rng)
+            return loss, grads
+
+        self._grad_step = jax.jit(grad_step)
+
+        def acc_add(acc, grads):
+            return jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+
+        self._acc_add = jax.jit(acc_add, donate_argnums=(0,))
+
+        def update_step(params, opt_state, scaler, grads, step):
+            return self._apply_update(params, opt_state, scaler, grads,
+                                      step, gas)
+
+        self._update_step = jax.jit(update_step, donate_argnums=(0, 1, 2, 3))
+
+        self._rng = jax.random.PRNGKey(self.config.seed + 1)
+
+    # ----------------------------------------------------------- parity API
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """Reference engine.py:is_gradient_accumulation_boundary."""
+        gas = int(self.config.gradient_accumulation_steps)
+        return (self.micro_steps + 1) % gas == 0
+
+    def forward(self, batch: Batch) -> jax.Array:
+        """Compute loss (+ cache grads for the following backward).
+
+        The reference runs autograd lazily; jax computes loss and grads in
+        one fused call here — ``backward`` then folds the cached grads into
+        the accumulator, preserving the 3-call API exactly."""
+        self._rng, sub = jax.random.split(self._rng)
+        batch = self._place_batch(batch)
+        loss, grads = self._grad_step(self.params, batch,
+                                      self.loss_scale_state.scale, sub)
+        self._pending_grads = grads
+        self._pending_loss = loss
+        return loss
+
+    def backward(self, loss: jax.Array) -> jax.Array:
+        """Fold pending grads into the accumulator (reference engine.py:2478)."""
+        if getattr(self, "_pending_grads", None) is None:
+            raise RuntimeError("backward() called without forward()")
+        if self._acc_grads is None:
+            self._acc_grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32), self._pending_grads)
+        else:
+            self._acc_grads = self._acc_add(self._acc_grads,
+                                            self._pending_grads)
+        self._pending_grads = None
+        self.micro_steps += 1
+        return loss
+
+    def step(self) -> None:
+        """Optimizer step at GAS boundary (reference engine.py:2653)."""
+        gas = int(self.config.gradient_accumulation_steps)
+        if self.micro_steps % gas != 0:
+            return
+        if self._acc_grads is None:
+            raise RuntimeError("step() called with no accumulated gradients")
+        self.params, self.opt_state, self.loss_scale_state, metrics = \
+            self._update_step(self.params, self.opt_state,
+                              self.loss_scale_state, self._acc_grads,
+                              jnp.int32(self.global_steps))
+        self._acc_grads = None
+        self.global_steps += 1
+        self.global_samples += int(self.config.train_batch_size)
+        if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
+            self.skipped_steps += 1
+        self._last_metrics = metrics
+        self._write_monitor(metrics)
+
+    def train_batch(self, data_iter: Optional[Iterator[Batch]] = None
+                    ) -> jax.Array:
+        """Fused whole-step path (reference PipelineEngine.train_batch:337 —
+        here the non-pipeline fast path; pipeline engine overrides)."""
+        gas = int(self.config.gradient_accumulation_steps)
+        it = data_iter if data_iter is not None else self._own_data_iterator()
+        micros = [next(it) for _ in range(gas)]
+        batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
+        batch = self._place_stacked_batch(batch)
+        self.tput_timer.start()
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, self.loss_scale_state, metrics = \
+            self._fused_step(self.params, self.opt_state,
+                             self.loss_scale_state, batch,
+                             jnp.int32(self.global_steps), sub)
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.global_samples += int(self.config.train_batch_size)
+        if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
+            self.skipped_steps += 1
+        self._last_metrics = metrics
+        loss = metrics["loss"]
+        self.tput_timer.stop()
+        self._write_monitor(metrics)
+        return loss
+
+    def _own_data_iterator(self):
+        """Persistent epoch-advancing iterator over the engine dataloader
+        (reference: the engine owns training_dataloader, deepspeed_io:2035)."""
+        if self.training_dataloader is None:
+            raise RuntimeError(
+                "train_batch() without data_iter requires training_data at "
+                "initialize()")
+        if getattr(self, "_data_iter", None) is None:
+            from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+            self._data_iter = iter(RepeatingLoader(self.training_dataloader))
+        return self._data_iter
+
+    # -------------------------------------------------------------- batches
+
+    def _place_batch(self, batch: Batch) -> Batch:
+        sh = self._batch_sharding(batch)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+
+    def _place_stacked_batch(self, batch: Batch) -> Batch:
+        """batch leaves: [gas, B, ...] — shard B (dim 1) over DP."""
+        sp = self.mesh.shape["seq"] > 1
+
+        def spec_for(x):
+            nd = np.ndim(x)
+            entries = [None, ZERO_AXES] + [None] * (nd - 2)
+            if sp and nd >= 3:
+                entries[2] = "seq"
+            return NamedSharding(self.mesh, P(*entries))
+        sh = jax.tree.map(spec_for, batch)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+
+    def _build_dataloader(self, training_data):
+        if training_data is None:
+            return None
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+        return DeepSpeedTPUDataLoader(
+            training_data,
+            micro_batch_size=int(self.config.train_micro_batch_size_per_gpu),
+            dp_world_size=self.dp_world_size,
+            seed=self.config.seed)
+
+    # -------------------------------------------------------------- monitor
+
+    def _build_monitor(self):
+        try:
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+            return MonitorMaster(self.config.monitor_config)
+        except Exception:
+            return None
+
+    def _write_monitor(self, metrics: Dict[str, jax.Array]) -> None:
+        if self.monitor is None or not self.monitor.enabled:
+            return
+        if self.global_steps % max(1, self.config.steps_per_print):
+            return
+        events = [(f"Train/{k}", float(jax.device_get(v)), self.global_steps)
+                  for k, v in metrics.items() if np.ndim(v) == 0]
+        self.monitor.write_events(events)
+
+    # ------------------------------------------------------------ utilities
+
+    def get_lr(self) -> float:
+        return float(jax.device_get(self.lr_schedule(jnp.int32(self.global_steps))))
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        m = getattr(self, "_last_metrics", None)
+        return float(jax.device_get(m["grad_norm"])) if m else None
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return int(self.config.train_micro_batch_size_per_gpu)
+
+    def train_batch_size(self) -> int:
+        return int(self.config.train_batch_size)
+
+    def gradient_accumulation_steps(self) -> int:
+        return int(self.config.gradient_accumulation_steps)
+
+    def loss_scale(self) -> float:
+        return float(jax.device_get(self.loss_scale_state.scale))
+
+    # --------------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict[str, Any]] = None,
+                        save_latest: bool = True) -> None:
+        """Reference engine.py:3621. Universal-by-construction format: every
+        param/opt leaf is written as full-shape fragments with axis metadata
+        so any later mesh can reload (deepspeed/checkpoint ds_to_universal
+        is unnecessary)."""
+        from deepspeed_tpu.checkpoint.store import save_checkpoint as _save
+        tag = tag or f"global_step{self.global_steps}"
+        state = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "loss_scale": self.loss_scale_state,
+        }
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "global_samples": self.global_samples,
+            "optimizer": self.optimizer.hyperparams,
+            "client_state": client_state or {},
+        }
+        _save(save_dir, tag, state, meta, save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True,
+                        **_kw) -> Tuple[Optional[str], Dict[str, Any]]:
+        """Reference engine.py:3273."""
+        from deepspeed_tpu.checkpoint.store import load_checkpoint as _load
+        shardings = {
+            "params": self._param_shardings,
+            "opt_state": self._state_shardings,
+            "loss_scale": jax.tree.map(lambda _: self.plan.replicated(),
+                                       self.loss_scale_state),
+        }
+        templates = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "loss_scale": self.loss_scale_state,
+        }
+        state, meta, tag = _load(load_dir, tag, templates, shardings)
+        if state is None:
+            return None, {}
+        self.params = state["params"]
+        if load_optimizer_states:
+            self.opt_state = state["opt_state"]
+        ls = state["loss_scale"]
+        self.loss_scale_state = LossScaleState(*jax.tree.leaves(ls)) \
+            if not isinstance(ls, LossScaleState) else ls
+        self.global_steps = meta.get("global_steps", 0)
+        self.micro_steps = meta.get("micro_steps", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        self.global_samples = meta.get("global_samples", 0)
+        return tag, meta.get("client_state", {})
+
+
+# ---------------------------------------------------------------------------
+# initialize()
+# ---------------------------------------------------------------------------
+
+def initialize(model: Union[ModelSpec, Any] = None,
+               config: Union[str, Dict[str, Any], DeepSpeedTPUConfig, None] = None,
+               mesh: Optional[Mesh] = None,
+               params: Optional[Pytree] = None,
+               rng: Optional[jax.Array] = None,
+               training_data=None,
+               loss_fn: Optional[LossFn] = None,
+               config_params=None,
+               **_kw):
+    """Reference deepspeed/__init__.py:78. Returns
+    (engine, optimizer, dataloader, lr_scheduler) for API parity."""
+    cfg = DeepSpeedTPUConfig.from_any(config if config is not None
+                                      else config_params)
+    spec = _coerce_model_spec(model, cfg, loss_fn)
+    engine = DeepSpeedTPUEngine(spec, cfg, mesh=mesh, params=params, rng=rng,
+                                training_data=training_data)
+    return engine, engine.optimizer, engine.training_dataloader, \
+        engine.lr_schedule
+
+
+def _coerce_model_spec(model, cfg: DeepSpeedTPUConfig,
+                       loss_fn: Optional[LossFn]) -> ModelSpec:
+    if isinstance(model, ModelSpec):
+        return model
+    from deepspeed_tpu.models.transformer import DecoderConfig
+    if isinstance(model, DecoderConfig):
+        from deepspeed_tpu.runtime.model_factory import decoder_model_spec
+        return decoder_model_spec(model, cfg)
+    raise TypeError(
+        "model must be a ModelSpec or a models.transformer.DecoderConfig; "
+        f"got {type(model)}")

@@ -1,0 +1,70 @@
+"""Dynamic loss scaling for fp16 parity.
+
+Reference: runtime/fp16/loss_scaler.py (LossScalerBase:43,
+LossScaler:75 static, DynamicLossScaler:99). TPU-native training is bf16
+and needs none of this; the machinery exists for API/numerics parity when
+a user config enables fp16. Implemented as a pure state record updated
+inside the jitted step (no Python-side branching on traced values).
+"""
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array            # f32 scalar
+    good_steps: jax.Array       # i32 consecutive overflow-free steps
+    hysteresis: jax.Array       # i32 remaining tolerance
+
+
+def init_loss_scale(static_scale: float = 0.0,
+                    initial_scale_power: int = 16,
+                    hysteresis: int = 2) -> LossScaleState:
+    scale = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
+    return LossScaleState(jnp.float32(scale), jnp.zeros((), jnp.int32),
+                          jnp.int32(hysteresis))
+
+
+def check_overflow(grads) -> jax.Array:
+    """Global NaN/Inf check (reference has_overflow_serial /
+    check_grad_overflow stage_1_and_2.py:172)."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.isfinite(g).all()) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_scale(state: LossScaleState, overflow: jax.Array,
+                 dynamic: bool = True,
+                 scale_factor: float = 2.0,
+                 scale_window: int = 1000,
+                 min_scale: float = 1.0,
+                 delayed_shift: int = 2,
+                 consecutive_hysteresis: bool = False
+                 ) -> LossScaleState:
+    """Reference DynamicLossScaler.update_scale (loss_scaler.py:150):
+    overflow decrements hysteresis and, once exhausted, halves the scale;
+    a full overflow-free window doubles the scale and restores hysteresis
+    to ``delayed_shift`` (:209); with ``consecutive_hysteresis`` the
+    restore happens on every good step instead."""
+    if not dynamic:
+        return state
+    hy = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0),
+                   state.hysteresis)
+    drop = jnp.logical_and(overflow, hy <= 0)
+    new_scale = jnp.where(
+        drop, jnp.maximum(state.scale / scale_factor, min_scale), state.scale)
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    grow = jnp.logical_and(jnp.logical_not(overflow),
+                           (good % scale_window) == 0)
+    grow = jnp.logical_and(grow, good > 0)
+    new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
+    if consecutive_hysteresis:
+        hy = jnp.where(jnp.logical_not(overflow), jnp.int32(delayed_shift), hy)
+    else:
+        hy = jnp.where(grow, jnp.int32(delayed_shift), hy)
+    return LossScaleState(new_scale, good, hy)

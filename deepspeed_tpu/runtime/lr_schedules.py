@@ -1,0 +1,168 @@
+"""Learning-rate schedules.
+
+TPU-native equivalent of the reference's ``runtime/lr_schedules.py``
+(LRRangeTest:277, OneCycle:375, WarmupLR:637, WarmupDecayLR:730,
+WarmupCosineLR:781). Instead of stateful torch schedulers mutating
+``optimizer.param_groups``, each schedule here is a pure function
+``step -> lr`` (jit-friendly: steps may be traced int arrays), built from a
+config block and fed to the engine's jitted train step.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]   # step (int or traced) -> lr (float array)
+
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+
+def constant_lr(lr: float) -> Schedule:
+    def fn(step):
+        return jnp.float32(lr)
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """Reference LRRangeTest (lr_schedules.py:277): lr grows from min_lr by
+    ``rate`` per (possibly fractional) step interval — LR range test a la
+    Smith 2017."""
+    def fn(step):
+        s = jnp.asarray(step, jnp.float32) / lr_range_test_step_size
+        if lr_range_test_staircase:
+            s = jnp.floor(s)
+        return jnp.float32(lr_range_test_min_lr) * \
+            (1.0 + s * lr_range_test_step_rate)
+    return fn
+
+
+def one_cycle(cycle_min_lr: float,
+              cycle_max_lr: float,
+              decay_lr_rate: float = 0.0,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0,
+              cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0,
+              **_ignored) -> Schedule:
+    """Reference OneCycle (lr_schedules.py:375): linear up over the first
+    phase, linear down over the second, then optional decay below min.
+    (Momentum cycling of the reference is handled by the engine when the
+    optimizer exposes beta1 — omitted round 1.)"""
+    second = cycle_second_step_size or cycle_first_step_size
+
+    def fn(step):
+        s = jnp.asarray(step, jnp.float32)
+        up_frac = jnp.clip(s / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((s - cycle_first_step_size) / second, 0.0, 1.0)
+        in_cycle_lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * \
+            jnp.where(s <= cycle_first_step_size, up_frac, 1.0 - down_frac)
+        post = s - (cycle_first_step_size + second)
+        if decay_lr_rate > 0 and decay_step_size > 0:
+            decay_intervals = jnp.floor(jnp.maximum(post, 0.0) / decay_step_size)
+            decayed = cycle_min_lr / (1.0 + decay_intervals * decay_lr_rate)
+            return jnp.where(post > 0, decayed, in_cycle_lr).astype(jnp.float32)
+        return jnp.where(post > 0, cycle_min_lr, in_cycle_lr).astype(jnp.float32)
+    return fn
+
+
+def _warmup_frac(step, warmup_num_steps: int, warmup_type: str):
+    s = jnp.asarray(step, jnp.float32)
+    w = jnp.float32(max(warmup_num_steps, 1))
+    if warmup_type == WARMUP_LOG_RATE:
+        # reference: inverse_log_warm_up * log(step + 1)
+        frac = jnp.log1p(jnp.minimum(s, w)) / jnp.log1p(w)
+    else:
+        frac = jnp.clip(s / w, 0.0, 1.0)
+    return frac
+
+
+def warmup_lr(warmup_min_lr: float = 0.0,
+              warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = WARMUP_LOG_RATE,
+              **_ignored) -> Schedule:
+    """Reference WarmupLR (lr_schedules.py:637): warm up then hold max."""
+    def fn(step):
+        frac = _warmup_frac(step, warmup_num_steps, warmup_type)
+        return jnp.float32(warmup_min_lr) + \
+            (warmup_max_lr - warmup_min_lr) * frac
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int,
+                    warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001,
+                    warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE,
+                    **_ignored) -> Schedule:
+    """Reference WarmupDecayLR (lr_schedules.py:730): warm up then linear
+    decay to 0 at total_num_steps."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                     warmup_type)
+
+    def fn(step):
+        s = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - s) /
+            jnp.float32(max(total_num_steps - warmup_num_steps, 1)),
+            0.0, 1.0)
+        # reference get_lr: min_lr + delta_lr * gamma — decays TO min_lr
+        decayed = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * decay
+        return jnp.where(s < warmup_num_steps, base(step),
+                         decayed).astype(jnp.float32)
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int,
+                     warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000,
+                     cos_min_ratio: float = 0.0001,
+                     warmup_type: str = WARMUP_LINEAR_RATE,
+                     base_lr: float = 1.0,
+                     **_ignored) -> Schedule:
+    """Reference WarmupCosineLR (lr_schedules.py:781): ratios are relative to
+    the optimizer's base lr."""
+    def fn(step):
+        s = jnp.asarray(step, jnp.float32)
+        wfrac = _warmup_frac(step, warmup_num_steps, warmup_type)
+        warm_ratio = warmup_min_ratio + (1.0 - warmup_min_ratio) * wfrac
+        progress = jnp.clip(
+            (s - warmup_num_steps) /
+            jnp.float32(max(total_num_steps - warmup_num_steps, 1)),
+            0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1.0 - cos_min_ratio) * \
+            0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        ratio = jnp.where(s < warmup_num_steps, warm_ratio, cos_ratio)
+        return (base_lr * ratio).astype(jnp.float32)
+    return fn
+
+
+#: reference lr_schedules.py VALID_LR_SCHEDULES
+_SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "lrrangetest": lr_range_test,
+    "onecycle": one_cycle,
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+}
+
+
+def build_schedule(name: Optional[str], params: Optional[Dict[str, Any]],
+                   base_lr: float) -> Schedule:
+    """Build from the config "scheduler" block (reference
+    runtime/config.py:get_scheduler_name); None → constant base_lr."""
+    if not name:
+        return constant_lr(base_lr)
+    key = name.lower()
+    if key not in _SCHEDULES:
+        raise ValueError(f"unknown scheduler '{name}'; known: {sorted(_SCHEDULES)}")
+    params = dict(params or {})
+    if key == "warmupcosinelr":
+        params.setdefault("base_lr", base_lr)
+    return _SCHEDULES[key](**params)

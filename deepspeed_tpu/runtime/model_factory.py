@@ -1,0 +1,90 @@
+"""Bridge from model configs to engine ModelSpecs.
+
+Plays the role of the reference's module-injection policies
+(module_inject/replace_module.py:189) — instead of mutating torch modules,
+we compose the functional transformer core with the attention / MoE
+implementation selected by the DeepSpeed config, and attach the sharding
+plan (partition_specs) for AutoTP + ZeRO-3.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import DeepSpeedTPUConfig
+from deepspeed_tpu.models import transformer
+from deepspeed_tpu.models.transformer import (DecoderConfig,
+                                              cross_entropy_loss,
+                                              dot_product_attention)
+
+
+def select_attention(ds_cfg: DeepSpeedTPUConfig):
+    """Pick the attention implementation from the parallel-topology config
+    (reference: DistributedAttention wrapping sequence/layer.py:331)."""
+    sp = ds_cfg.sequence_parallel
+    if sp.size > 1:
+        if sp.mode == "ring":
+            from deepspeed_tpu.parallel.ring import ring_attention
+            return partial(ring_attention, axis_name="seq")
+        from deepspeed_tpu.parallel.ulysses import distributed_attention
+        return partial(distributed_attention, axis_name="seq")
+    return dot_product_attention
+
+
+def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):
+    if not dec_cfg.num_experts:
+        return None
+    from deepspeed_tpu.parallel.moe import moe_layer
+    return partial(moe_layer,
+                   top_k=dec_cfg.num_experts_per_tok,
+                   capacity_factor=ds_cfg.moe.capacity_factor,
+                   min_capacity=ds_cfg.moe.min_capacity,
+                   drop_tokens=ds_cfg.moe.drop_tokens,
+                   aux_loss_coef=ds_cfg.moe.aux_loss_coef,
+                   ep_axis="expert" if ds_cfg.moe.ep_size > 1 else None)
+
+
+def decoder_model_spec(dec_cfg: DecoderConfig,
+                       ds_cfg: DeepSpeedTPUConfig):
+    """Build the engine ModelSpec for the flagship decoder family.
+
+    Batch contract: {"input_ids": [B,T] int32, "labels": [B,T] int32
+    (optional; defaults to shifted input_ids)}.
+    """
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    attn_fn = select_attention(ds_cfg)
+    moe_fn = select_moe(dec_cfg, ds_cfg)
+    remat = ds_cfg.activation_checkpointing.policy
+
+    def init_fn(rng):
+        return transformer.init_params(dec_cfg, rng)
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["input_ids"]
+        if "labels" in batch:
+            labels = batch["labels"]
+        else:
+            labels = jnp.concatenate(
+                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        if moe_fn is not None:
+            logits, aux = transformer.forward(
+                dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=moe_fn,
+                remat_policy=remat, with_aux=True)
+            return cross_entropy_loss(logits, labels) + aux
+        logits = transformer.forward(dec_cfg, params, tokens,
+                                     attn_fn=attn_fn, moe_fn=moe_fn,
+                                     remat_policy=remat)
+        return cross_entropy_loss(logits, labels)
+
+    tp = ds_cfg.tensor_parallel.enabled
+    specs = transformer.partition_specs(
+        dec_cfg, zero_stage=ds_cfg.zero_optimization.stage, tp=tp)
+
+    n = dec_cfg.num_params()
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn,
+                     partition_specs=specs,
+                     flops_per_token=6.0 * n,
+                     tokens_per_sample=dec_cfg.max_seq_len)

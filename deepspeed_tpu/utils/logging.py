@@ -19,7 +19,7 @@ def _create_logger(name: str = "deepspeed_tpu") -> logging.Logger:
     lg = logging.getLogger(name)
     lg.setLevel(getattr(logging, LOG_LEVEL, logging.INFO))
     lg.propagate = False
-    handler = logging.StreamHandler(stream=sys.stdout)
+    handler = logging.StreamHandler(stream=sys.stderr)
     handler.setFormatter(
         logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
     lg.addHandler(handler)

@@ -1,0 +1,198 @@
+"""End-to-end engine tests: ZeRO stage parity vs plain-jax baseline
+(reference test strategy: tests/unit/runtime/zero/ — Z1/2/3 correctness vs
+torch baseline on toy models, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import gpt2_config
+from deepspeed_tpu.models.transformer import (cross_entropy_loss, forward,
+                                              init_params)
+from deepspeed_tpu.ops.optimizers import adam
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.runtime.engine import initialize
+
+VOCAB = 512
+SEQ = 32
+GLOBAL_BATCH = 16
+
+
+def _data(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(steps):
+        tok = rng.integers(0, VOCAB, size=(GLOBAL_BATCH, SEQ), dtype=np.int32)
+        batches.append({"input_ids": tok})
+    return batches
+
+
+def _config(stage, dtype="fp32", gas=1, micro=GLOBAL_BATCH):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro // 8,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adam",
+                      "params": {"lr": 1e-3, "betas": [0.9, 0.999]}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+    }
+    if dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    if dtype == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    return cfg
+
+
+def _baseline_losses(steps=4, lr=1e-3, clip=1.0):
+    """Plain jax training loop, single device, fp32."""
+    cfg = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    params = init_params(cfg, jax.random.PRNGKey(1234))
+    opt = adam(adam_w_mode=False)
+    state = opt.init(params)
+
+    def loss_of(p, tokens):
+        labels = jnp.concatenate(
+            [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        return cross_entropy_loss(forward(cfg, p, tokens), labels)
+
+    @jax.jit
+    def step_fn(p, s, tokens):
+        loss, grads = jax.value_and_grad(loss_of)(p, tokens)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                          for g in jax.tree.leaves(grads)))
+        factor = jnp.minimum(1.0, clip / (gn + 1e-6))
+        grads = jax.tree.map(lambda g: g * factor, grads)
+        p, s = opt.update(grads, s, p, jnp.float32(lr))
+        return p, s, loss
+
+    losses = []
+    for batch in _data(steps):
+        params, state, loss = step_fn(params, state,
+                                      jnp.asarray(batch["input_ids"]))
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return _baseline_losses()
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_parity(stage, baseline, devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    engine, _, _, _ = initialize(
+        model=model, config=_config(stage),
+        rng=jax.random.PRNGKey(1234))
+    losses = [float(engine.train_batch(iter([b]))) for b in _data(4)]
+    np.testing.assert_allclose(losses, baseline, rtol=2e-4, atol=2e-4)
+
+
+def test_forward_backward_step_api(devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    engine, _, _, _ = initialize(model=model, config=_config(2),
+                                 rng=jax.random.PRNGKey(7))
+    data = _data(2, seed=3)
+    for batch in data:
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        assert engine.is_gradient_accumulation_boundary() or True
+        engine.step()
+    assert engine.global_steps == 2
+    assert np.isfinite(float(loss))
+
+
+def test_gas_equivalence(devices):
+    """2 microbatches × GAS=2 must equal one batch of 2× size (reference
+    GAS accounting semantics, engine.py:2580)."""
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    data = _data(2, seed=5)
+
+    # GAS=2 over two microbatches of 16
+    e1, _, _, _ = initialize(model=model, config=_config(0, gas=2),
+                             rng=jax.random.PRNGKey(0))
+    loss1 = e1.train_batch(iter(data))
+    p1 = jax.device_get(e1.params["embed"]["tokens"])
+
+    # one fused step over a single 32-sample microbatch: equivalent because
+    # CE loss is token-mean and both micros carry the same token count
+    e2, _, _, _ = initialize(model=model,
+                             config=_config(0, micro=2 * GLOBAL_BATCH),
+                             rng=jax.random.PRNGKey(0))
+    big = {"input_ids": np.concatenate([d["input_ids"] for d in data])}
+    loss2 = e2.train_batch(iter([big]))
+    p2 = jax.device_get(e2.params["embed"]["tokens"])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+
+
+def test_bf16_trains(devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    engine, _, _, _ = initialize(model=model, config=_config(3, dtype="bf16"),
+                                 rng=jax.random.PRNGKey(11))
+    losses = [float(engine.train_batch(iter([b]))) for b in _data(3, seed=9)]
+    assert all(np.isfinite(losses))
+    # opt state holds fp32 master for bf16 params
+    assert engine.opt_state["master"]["embed"]["tokens"].dtype == jnp.float32
+
+
+def test_fp16_loss_scaler_engages(devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    engine, _, _, _ = initialize(model=model, config=_config(0, dtype="fp16"),
+                                 rng=jax.random.PRNGKey(13))
+    assert engine.loss_scale() == 2.0 ** 16
+    loss = engine.train_batch(iter(_data(1)))
+    assert np.isfinite(float(loss))
+
+
+def test_checkpoint_roundtrip(tmp_path, devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    engine, _, _, _ = initialize(model=model, config=_config(2),
+                                 rng=jax.random.PRNGKey(21))
+    data = _data(3, seed=17)
+    engine.train_batch(iter(data[:1]))
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+
+    # continue two more steps
+    for b in data[1:]:
+        engine.train_batch(iter([b]))
+    final_direct = jax.device_get(engine.params["embed"]["tokens"])
+
+    # reload into a NEW engine with a DIFFERENT zero stage (universal
+    # reshape property) and replay the same two steps
+    engine2, _, _, _ = initialize(model=model, config=_config(3),
+                                  rng=jax.random.PRNGKey(99))
+    tag, client = engine2.load_checkpoint(str(tmp_path))
+    assert client["note"] == "hi"
+    assert engine2.global_steps == 1
+    for b in data[1:]:
+        engine2.train_batch(iter([b]))
+    final_resumed = jax.device_get(engine2.params["embed"]["tokens"])
+    np.testing.assert_allclose(final_direct, final_resumed, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_dataloader_and_train(devices):
+    build_mesh(data=8)
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    rng = np.random.default_rng(0)
+    dataset = [{"input_ids": rng.integers(0, VOCAB, size=(SEQ,),
+                                          dtype=np.int32)}
+               for _ in range(64)]
+    engine, _, loader, _ = initialize(
+        model=model, config=_config(1, gas=2, micro=GLOBAL_BATCH),
+        rng=jax.random.PRNGKey(3), training_data=dataset)
+    assert loader is not None
+    assert len(loader) == 64 // GLOBAL_BATCH
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    it = iter(RepeatingLoader(loader))
+    loss = engine.train_batch(it)
+    assert np.isfinite(float(loss))

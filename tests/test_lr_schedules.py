@@ -1,0 +1,86 @@
+"""LR schedule behavior (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules as lrs
+
+
+def _v(fn, step):
+    return float(fn(step))
+
+
+def test_constant():
+    fn = lrs.constant_lr(0.01)
+    assert _v(fn, 0) == pytest.approx(0.01)
+    assert _v(fn, 10_000) == pytest.approx(0.01)
+
+
+def test_warmup_linear():
+    fn = lrs.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1,
+                       warmup_num_steps=100, warmup_type="linear")
+    assert _v(fn, 0) == pytest.approx(0.0)
+    assert _v(fn, 50) == pytest.approx(0.05)
+    assert _v(fn, 100) == pytest.approx(0.1)
+    assert _v(fn, 500) == pytest.approx(0.1)
+
+
+def test_warmup_log():
+    fn = lrs.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1,
+                       warmup_num_steps=100, warmup_type="log")
+    vals = [_v(fn, s) for s in (0, 10, 50, 100, 200)]
+    assert vals[0] == pytest.approx(0.0)
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+    assert vals[3] == pytest.approx(0.1, abs=1e-6)
+
+
+def test_warmup_decay_hits_zero():
+    fn = lrs.warmup_decay_lr(total_num_steps=1000, warmup_max_lr=0.1,
+                             warmup_num_steps=100, warmup_type="linear")
+    assert _v(fn, 100) == pytest.approx(0.1, abs=1e-6)
+    assert _v(fn, 550) == pytest.approx(0.05, abs=1e-3)
+    assert _v(fn, 1000) == pytest.approx(0.0, abs=1e-6)
+    assert _v(fn, 2000) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_warmup_cosine():
+    fn = lrs.warmup_cosine_lr(total_num_steps=1000, warmup_num_steps=100,
+                              cos_min_ratio=0.1, base_lr=0.2)
+    assert _v(fn, 100) == pytest.approx(0.2, rel=1e-3)
+    # halfway through cosine: ratio = 0.1 + 0.9*0.5
+    assert _v(fn, 550) == pytest.approx(0.2 * 0.55, rel=1e-2)
+    assert _v(fn, 1000) == pytest.approx(0.2 * 0.1, rel=1e-3)
+
+
+def test_one_cycle():
+    fn = lrs.one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                       cycle_first_step_size=100)
+    assert _v(fn, 0) == pytest.approx(0.01)
+    assert _v(fn, 100) == pytest.approx(0.1)
+    assert _v(fn, 150) == pytest.approx(0.055, abs=1e-3)
+    assert _v(fn, 200) == pytest.approx(0.01)
+    assert _v(fn, 1000) == pytest.approx(0.01)
+
+
+def test_lr_range_test():
+    fn = lrs.lr_range_test(lr_range_test_min_lr=0.001,
+                           lr_range_test_step_size=10,
+                           lr_range_test_step_rate=1.0)
+    assert _v(fn, 0) == pytest.approx(0.001)
+    assert _v(fn, 10) == pytest.approx(0.002)
+    staircase = lrs.lr_range_test(lr_range_test_min_lr=0.001,
+                                  lr_range_test_step_size=10,
+                                  lr_range_test_step_rate=1.0,
+                                  lr_range_test_staircase=True)
+    assert _v(staircase, 9) == pytest.approx(0.001)
+    assert _v(staircase, 10) == pytest.approx(0.002)
+
+
+def test_build_schedule_dispatch():
+    fn = lrs.build_schedule("WarmupLR", {"warmup_max_lr": 0.5,
+                                         "warmup_num_steps": 10}, 0.1)
+    assert _v(fn, 10) == pytest.approx(0.5, abs=1e-6)
+    fn = lrs.build_schedule(None, None, 0.07)
+    assert _v(fn, 123) == pytest.approx(0.07)
+    with pytest.raises(ValueError):
+        lrs.build_schedule("bogus", {}, 0.1)
