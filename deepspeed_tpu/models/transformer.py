@@ -23,7 +23,7 @@ designed TPU-first:
 import dataclasses
 import math
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -212,15 +212,19 @@ def _attention_block(cfg: DecoderConfig, p: Params, x: jax.Array,
 
 def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
                   attn_fn: AttentionFn,
-                  moe_fn: Optional[Callable] = None) -> jax.Array:
+                  moe_fn: Optional[Callable] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (hidden, aux_loss) — aux is 0 for dense blocks, the scaled
+    load-balance loss for MoE blocks (reference sharded_moe.py l_aux)."""
     h = x + _attention_block(cfg, p["attn"], _norm(cfg, p["ln1"], x),
                              sin, cos, attn_fn)
     normed = _norm(cfg, p["ln2"], h)
     if cfg.num_experts and moe_fn is not None:
-        ff, _aux = moe_fn(cfg, p["moe"], normed)
+        ff, aux = moe_fn(cfg, p["moe"], normed)
     else:
         ff = _mlp(cfg, p["mlp"], normed)
-    return h + ff
+        aux = jnp.zeros((), jnp.float32)
+    return h + ff, aux
 
 
 # ---------------------------------------------------------------------------
@@ -297,8 +301,11 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
             attn_fn: AttentionFn = dot_product_attention,
             moe_fn: Optional[Callable] = None,
             positions: Optional[jax.Array] = None,
-            remat_policy: Optional[str] = None) -> jax.Array:
-    """tokens: [B, T] int32 → logits [B, T, V] (fp32).
+            remat_policy: Optional[str] = None,
+            with_aux: bool = False
+            ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """tokens: [B, T] int32 → logits [B, T, V] (fp32); with ``with_aux``
+    returns (logits, summed MoE aux loss).
 
     Layers applied with ``lax.scan`` over the stacked pytree; optional
     ``jax.checkpoint`` per block (the reference's activation checkpointing
@@ -317,8 +324,8 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     block = partial(decoder_block, cfg, attn_fn=attn_fn, moe_fn=moe_fn)
 
     def body(carry, layer_params):
-        out = block(layer_params, carry, sin, cos)
-        return out, None
+        out, aux = block(layer_params, carry, sin, cos)
+        return out, aux
 
     if remat_policy and remat_policy != "none":
         policies = {
@@ -331,7 +338,7 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
         policy = policies.get(remat_policy)
         body = jax.checkpoint(body, policy=policy)
 
-    x, _ = lax.scan(body, x, params["layers"])
+    x, aux = lax.scan(body, x, params["layers"])
     x = _norm(cfg, params["final_norm"], x)
     if cfg.tie_embeddings:
         logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
@@ -339,6 +346,8 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     else:
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                             preferred_element_type=jnp.float32)
+    if with_aux:
+        return logits, jnp.sum(aux)
     return logits
 
 

@@ -1,0 +1,122 @@
+"""Ring attention — ICI-idiomatic context parallelism.
+
+The reference has NO ring attention (SURVEY.md §2.3: long context is
+Ulysses all-to-all + FPDT chunking); this is the TPU-native addition the
+survey calls for: K/V blocks rotate around the 'seq' ring via
+``lax.ppermute`` (nearest-neighbour ICI traffic, bandwidth-optimal) while
+each device keeps its query block resident, accumulating attention with an
+online-softmax (flash-style) update in fp32.
+
+Comm cost per device: (sp-1) ppermutes of the local KV block — O(T/sp)
+bytes per hop on a physical ring, vs Ulysses' all-to-all O(T/sp) with
+full bisection. Ring wins when sp exceeds the all-to-all-efficient pod
+slice or when heads < sp (Ulysses can't shard).
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.comms_logger import comms_logger
+from deepspeed_tpu.parallel.mesh import get_mesh
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, qpos, kpos, causal):
+    """One q-block × kv-block partial attention.
+
+    q: [B,Tq,H,D] k/v: [B,Tk,KvH,D]; returns (scores-exp sum stats).
+    GQA via head grouping (no materialized repeat). fp32 throughout.
+    """
+    b, tq, h, d = q.shape
+    _, tk, kvh, _ = k.shape
+    groups = h // kvh
+    qg = q.reshape(b, tq, kvh, groups, d)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                   preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(d))
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    return s  # [B,KvH,G,Tq,Tk]
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                         causal: bool = True,
+                         q_offset: int = 0,
+                         axis_name: str = "seq") -> jax.Array:
+    """Per-shard body: q/k/v are LOCAL blocks [B, T/sp, H|KvH, D].
+
+    Must run inside shard_map/pmap with ``axis_name`` manual.
+    """
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    _, tk, kvh, _ = k.shape
+    groups = h // kvh
+    qpos = idx * tq + jnp.arange(tq) + q_offset
+
+    o0 = jnp.zeros((b, kvh, groups, tq, d), jnp.float32)
+    m0 = jnp.full((b, kvh, groups, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, groups, tq), jnp.float32)
+    # mark the constants as device-varying over the ring axis (jax VMA)
+    o0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
+                  for x in (o0, m0, l0))
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - i) % sp                     # chunk id currently held
+        kpos = src * tk + jnp.arange(tk)
+        s = _block_attend(q, k_cur, v_cur, qpos, kpos, causal)
+        blk_max = jnp.max(s, axis=-1)            # [B,KvH,G,Tq]
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (max = -inf): contribute nothing
+        alive = new_m > _NEG_INF / 2
+        p = jnp.exp(s - jnp.where(alive, new_m, 0.0)[..., None])
+        p = jnp.where(alive[..., None], p, 0.0)
+        corr = jnp.where(alive, jnp.exp(m - jnp.where(alive, new_m, 0.0)), 0.0)
+        corr = jnp.where(m > _NEG_INF / 2, corr, 0.0)
+        pv = jnp.einsum("bkgts,bskd->bkgtd", p,
+                        v_cur.astype(jnp.float32))
+        o = o * corr[..., None] + pv
+        l = l * corr + jnp.sum(p, axis=-1)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, new_m, l, k_nxt, v_nxt)
+
+    o, m, l, _, _ = lax.fori_loop(0, sp, body, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, tq, h, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   q_offset: int = 0,
+                   axis_name: str = "seq") -> jax.Array:
+    """Drop-in ``attn_fn`` over GLOBAL arrays [B,T,H,D]: wraps the local
+    ring body in a partial-manual shard_map over the 'seq' axis (other
+    mesh axes stay automatic, so ZeRO/TP shardings pass through)."""
+    mesh = get_mesh()
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        from deepspeed_tpu.models.transformer import dot_product_attention
+        return dot_product_attention(q, k, v, causal=causal,
+                                     q_offset=q_offset)
+    comms_logger.append("ppermute",
+                        (k.size + v.size) * k.dtype.itemsize * (sp - 1),
+                        axis_name)
+    fn = jax.shard_map(
+        partial(ring_attention_local, causal=causal, q_offset=q_offset,
+                axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(None, axis_name, None, None),) * 3,
+        out_specs=P(None, axis_name, None, None),
+        axis_names={axis_name})
+    return fn(q, k, v)

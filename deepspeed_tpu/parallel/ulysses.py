@@ -1,0 +1,77 @@
+"""Ulysses sequence parallelism — TPU-native.
+
+Reference: ``deepspeed/sequence/layer.py`` (``single_all_to_all``:221,
+``_SeqAllToAll``:277, ``DistributedAttention``:331). The reference wraps a
+local attention with two explicit all-to-alls: scatter heads / gather
+sequence before attention, and the inverse after. On TPU the same data
+movement is expressed as two sharding constraints: activations arrive
+sequence-sharded ``[B, T/sp, H, D]`` and are *resharded* to head-sharded
+``[B, T, H/sp, D]`` — XLA lowers that transposed resharding to exactly the
+ICI all-to-all of the reference, fused and overlapped by its scheduler.
+
+Composes with tensor parallelism (heads sharded over ('model','seq')
+jointly) and GQA (KV heads shard only when divisible; the reference's
+uneven-head path `sequence/layer.py` get_num_kv_heads — here: replicate
+when indivisible).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.comms_logger import comms_logger
+from deepspeed_tpu.models.transformer import dot_product_attention
+from deepspeed_tpu.parallel.mesh import ZERO_AXES, get_mesh
+
+
+def _head_sharding(n_heads_axis_size: int, mesh, axis_name: str,
+                   with_tp: bool):
+    """Pick the head-dim sharding for attention time; None if indivisible."""
+    total = mesh.shape[axis_name] * (mesh.shape["model"] if with_tp else 1)
+    if n_heads_axis_size % total == 0:
+        return ("model", axis_name) if with_tp else axis_name
+    if with_tp and n_heads_axis_size % mesh.shape["model"] == 0:
+        return "model"
+    return None
+
+
+def distributed_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = True,
+                          q_offset: int = 0,
+                          axis_name: str = "seq",
+                          inner=dot_product_attention) -> jax.Array:
+    """Drop-in ``attn_fn``: q [B,T,H,D], k/v [B,T,KvH,D] (global view,
+    sequence dim sharded over ``axis_name`` by the batch input sharding).
+
+    Reference call structure (DistributedAttention.forward:331):
+    all_to_all(q,k,v) → local attn → all_to_all(out).
+    """
+    mesh = get_mesh()
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        return inner(q, k, v, causal=causal, q_offset=q_offset)
+    with_tp = mesh.shape["model"] > 1
+
+    h_shard = _head_sharding(q.shape[2], mesh, axis_name, with_tp)
+    kv_shard = _head_sharding(k.shape[2], mesh, axis_name, with_tp)
+
+    comms_logger.append("all_to_all",
+                        q.size * q.dtype.itemsize, axis_name)
+
+    # scatter heads / gather sequence (reference single_all_to_all:221)
+    q = jax.lax.with_sharding_constraint(
+        q, jax.sharding.NamedSharding(mesh, P(ZERO_AXES, None, h_shard, None)))
+    k = jax.lax.with_sharding_constraint(
+        k, jax.sharding.NamedSharding(mesh, P(ZERO_AXES, None, kv_shard, None)))
+    v = jax.lax.with_sharding_constraint(
+        v, jax.sharding.NamedSharding(mesh, P(ZERO_AXES, None, kv_shard, None)))
+
+    out = inner(q, k, v, causal=causal, q_offset=q_offset)
+
+    # gather heads / scatter sequence back (the inverse all-to-all)
+    out = jax.lax.with_sharding_constraint(
+        out, jax.sharding.NamedSharding(
+            mesh, P(ZERO_AXES, axis_name, "model" if with_tp else None, None)))
+    return out
