@@ -89,12 +89,14 @@ def main() -> None:
         0, model.vocab_size, size=(gb, seq), dtype=np.int32)}
 
     for _ in range(warmup):
-        jax.block_until_ready(engine.train_batch(iter([batch_data])))
+        float(engine.train_batch(iter([batch_data])))
 
+    # force materialization with a host fetch each step — under the axon
+    # tunnel block_until_ready alone does not guarantee remote execution
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(iter([batch_data]))
-    jax.block_until_ready(loss)
+        loss_val = float(loss)
     dt = time.perf_counter() - t0
 
     tokens = gb * seq * steps
@@ -116,7 +118,7 @@ def main() -> None:
         "extra": {
             "mfu": round(mfu, 4),
             "achieved_tflops_per_chip": round(achieved / 1e12, 2),
-            "loss": float(loss),
+            "loss": loss_val,
             "platform": platform,
             "n_devices": n_dev,
             "steps": steps,

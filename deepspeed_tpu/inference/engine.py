@@ -1,0 +1,187 @@
+"""Inference engine v1 — TP-sharded KV-cached generation.
+
+Reference: ``deepspeed.init_inference`` (deepspeed/__init__.py:302) →
+``InferenceEngine`` (inference/engine.py:40). The reference swaps modules
+for fused CUDA kernels and captures CUDA graphs; here the forward is one
+jitted cached-decode function (jit *is* the graph capture — reference
+_create_cuda_graph:496 is subsumed), TP sharding comes from the model's
+partition specs over the 'model' mesh axis, and the KV cache is a
+static-shape pytree updated in place with buffer donation.
+
+Sampling: greedy, temperature, top-k, top-p (reference relies on HF
+generate; serving loops here need it built in).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.config.config_utils import TPUConfigModel
+from deepspeed_tpu.models.transformer import (DecoderConfig,
+                                              forward_with_cache,
+                                              init_kv_cache, init_params,
+                                              partition_specs)
+from deepspeed_tpu.parallel.mesh import build_mesh, get_mesh, has_mesh
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedTPUInferenceConfig(TPUConfigModel):
+    """Reference: inference/config.py:DeepSpeedInferenceConfig (subset)."""
+    tensor_parallel: Dict[str, Any] = {}
+    dtype: str = "bfloat16"
+    max_out_tokens: int = 1024
+    max_batch_size: int = 8
+    replace_with_kernel_inject: bool = False   # parity no-op: jit fuses
+    min_out_tokens: int = 1
+
+    @property
+    def tp_size(self) -> int:
+        return int(self.tensor_parallel.get("tp_size", 1) or 1)
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int, top_p: float) -> jax.Array:
+    """logits [B, V] → token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngineTPU:
+    """KV-cached generation over a mesh (reference inference/engine.py:40)."""
+
+    def __init__(self, model: DecoderConfig,
+                 config: Union[Dict[str, Any], DeepSpeedTPUInferenceConfig, None] = None,
+                 params=None, rng: Optional[jax.Array] = None,
+                 mesh=None):
+        if isinstance(config, dict) or config is None:
+            config = DeepSpeedTPUInferenceConfig(**(config or {}))
+        self.model_config = model
+        self.config = config
+        if mesh is not None:
+            self.mesh = mesh
+        elif has_mesh():
+            self.mesh = get_mesh()
+        else:
+            self.mesh = build_mesh(model=config.tp_size)
+        self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                      "float16": jnp.float16}[config.dtype]
+
+        tp = self.mesh.shape["model"] > 1
+        specs = partition_specs(model, zero_stage=0, tp=tp)
+        self._param_sh = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if params is None:
+            init = jax.jit(
+                lambda r: jax.tree.map(
+                    lambda x: x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    init_params(model, r)),
+                out_shardings=self._param_sh)
+            self.params = init(rng)
+        else:
+            self.params = jax.device_put(
+                jax.tree.map(lambda x: x.astype(self.dtype)
+                             if jnp.issubdtype(x.dtype, jnp.floating)
+                             else x, params),
+                self._param_sh)
+
+        # KV cache sharded over batch (DP axes) and kv heads (model axis
+        # when divisible)
+        kv_h = "model" if (tp and model.kv_heads % self.mesh.shape["model"]
+                           == 0) else None
+        self._cache_sh = NamedSharding(
+            self.mesh, P(None, ("data", "expert"), None, kv_h, None))
+
+        self._step = jax.jit(
+            partial(forward_with_cache, model),
+            donate_argnums=(2,))
+        self._samplers: Dict[Tuple[float, int, float], Any] = {}
+        log_dist(f"inference engine ready: tp={self.mesh.shape['model']} "
+                 f"dtype={config.dtype} max_out={config.max_out_tokens}")
+
+    def _sampler(self, temperature: float, top_k: int, top_p: float):
+        """jit cache keyed on sampling params (a fresh jit(partial(...))
+        per call would re-trace every request)."""
+        key = (temperature, top_k, top_p)
+        if key not in self._samplers:
+            self._samplers[key] = jax.jit(partial(
+                _sample, temperature=temperature, top_k=top_k, top_p=top_p))
+        return self._samplers[key]
+
+    def _new_cache(self, batch: int, max_len: int):
+        cache = init_kv_cache(self.model_config, batch, max_len, self.dtype)
+        return jax.device_put(cache, {"k": self._cache_sh,
+                                      "v": self._cache_sh})
+
+    def generate(self, input_ids, max_new_tokens: int = 64,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> np.ndarray:
+        """input_ids: [B, T] int32 → [B, T + max_new_tokens] (right side
+        fills with eos after termination when eos_token_id given)."""
+        input_ids = np.asarray(input_ids, np.int32)
+        b, t = input_ids.shape
+        max_len = t + max_new_tokens
+        cache = self._new_cache(b, max_len)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        tokens = jnp.asarray(input_ids)
+        logits, cache = self._step(self.params, tokens, cache,
+                                   jnp.int32(0))
+        out = [input_ids]
+        done = np.zeros((b,), bool)
+        cur_len = t
+        sampler = self._sampler(temperature, top_k, top_p)
+        for _ in range(max_new_tokens):
+            rng, sub = jax.random.split(rng)
+            nxt = sampler(logits, sub)
+            nxt_np = np.asarray(jax.device_get(nxt))
+            if eos_token_id is not None:
+                nxt_np = np.where(done, eos_token_id, nxt_np)
+                done |= nxt_np == eos_token_id
+            out.append(nxt_np[:, None])
+            if eos_token_id is not None and done.all():
+                break
+            logits, cache = self._step(
+                self.params, jnp.asarray(nxt_np[:, None]), cache,
+                jnp.int32(cur_len))
+            cur_len += 1
+        result = np.concatenate(out, axis=1)
+        if result.shape[1] < max_len:
+            # early EOS exit: pad to the documented [B, T+max_new_tokens]
+            pad = np.full((b, max_len - result.shape[1]),
+                          eos_token_id if eos_token_id is not None else 0,
+                          np.int32)
+            result = np.concatenate([result, pad], axis=1)
+        return result
+
+    def forward(self, input_ids) -> jax.Array:
+        """Full-sequence logits (no cache) — parity with engine forward."""
+        from deepspeed_tpu.models.transformer import forward
+        return jax.jit(partial(forward, self.model_config))(
+            self.params, jnp.asarray(input_ids, jnp.int32))
+
+
+def init_inference(model: DecoderConfig, config=None, **kwargs
+                   ) -> InferenceEngineTPU:
+    """Reference deepspeed/__init__.py:302."""
+    return InferenceEngineTPU(model, config, **kwargs)

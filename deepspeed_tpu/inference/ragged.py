@@ -1,0 +1,160 @@
+"""Ragged batching state — the FastGen-core state layer.
+
+Reference: ``deepspeed/inference/v2/ragged/`` — ``DSStateManager``
+(ragged_manager.py:19), ``BlockedAllocator`` (blocked_allocator.py:11),
+``DSSequenceDescriptor`` (sequence_descriptor.py:59), ``RaggedBatchWrapper``
+(ragged_wrapper.py:31). Host-side bookkeeping is a direct functional
+analogue; the device side differs: rather than CUDA paged-KV kernels, the
+scheduler packs sequences into a shared static-shape KV arena whose pages
+are tracked here (a Pallas paged-attention kernel can later consume the
+same page tables).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Fixed pool of KV pages (reference blocked_allocator.py:11)."""
+
+    def __init__(self, num_blocks: int, block_size: int = 128):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV arena exhausted: want {n} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b < 0 or b >= self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+            self._free.append(b)
+
+
+@dataclass
+class SequenceDescriptor:
+    """Reference sequence_descriptor.py:59."""
+    uid: int
+    tokens: List[int] = field(default_factory=list)
+    seen_tokens: int = 0            # tokens already in KV
+    blocks: List[int] = field(default_factory=list)
+    slot: Optional[int] = None      # row in the packed decode batch
+    done: bool = False
+
+    @property
+    def pending(self) -> int:
+        return len(self.tokens) - self.seen_tokens
+
+
+class DSStateManager:
+    """Tracks live sequences + KV pages (reference ragged_manager.py:19)."""
+
+    def __init__(self, max_sequences: int = 64, num_blocks: int = 512,
+                 block_size: int = 128):
+        self.max_sequences = max_sequences
+        self.allocator = BlockedAllocator(num_blocks, block_size)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._slots: List[int] = list(range(max_sequences - 1, -1, -1))
+
+    def get_or_create_sequence(self, uid: int) -> SequenceDescriptor:
+        if uid not in self.seqs:
+            if not self._slots:
+                raise RuntimeError("max_sequences exceeded")
+            self.seqs[uid] = SequenceDescriptor(uid=uid,
+                                                slot=self._slots.pop())
+        return self.seqs[uid]
+
+    def extend(self, uid: int, token_ids) -> SequenceDescriptor:
+        seq = self.get_or_create_sequence(uid)
+        seq.tokens.extend(int(t) for t in np.asarray(token_ids).reshape(-1))
+        needed = -(-len(seq.tokens) // self.allocator.block_size) \
+            - len(seq.blocks)
+        if needed > 0:
+            seq.blocks.extend(self.allocator.allocate(needed))
+        return seq
+
+    def flush(self, uid: int) -> None:
+        """Release a finished sequence (reference engine_v2.py flush:242)."""
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.allocator.free(seq.blocks)
+            self._slots.append(seq.slot)
+
+    def can_schedule(self, n_tokens: int) -> bool:
+        """Capacity check (reference engine_v2.py can_schedule:158)."""
+        blocks = -(-n_tokens // self.allocator.block_size)
+        return blocks <= self.allocator.free_blocks and \
+            len(self.seqs) < self.max_sequences
+
+
+@dataclass
+class RaggedBatch:
+    """One scheduler step's work (reference ragged_wrapper.py:31)."""
+    uids: List[int]
+    token_ids: np.ndarray        # padded [n_seq, max_chunk]
+    token_counts: np.ndarray     # [n_seq] actual new tokens
+    start_positions: np.ndarray  # [n_seq] seen_tokens before this step
+    slots: np.ndarray            # [n_seq] KV arena rows
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.token_counts.sum())
+
+
+class RaggedScheduler:
+    """Continuous-batching scheduler: mixes prefill chunks and decode steps
+    into one ragged batch per engine step (FastGen's Dynamic SplitFuse,
+    reference inference/v2 engine put():107 semantics)."""
+
+    def __init__(self, state: DSStateManager, max_batch_tokens: int = 2048,
+                 prefill_chunk: int = 512):
+        self.state = state
+        self.max_batch_tokens = max_batch_tokens
+        self.prefill_chunk = prefill_chunk
+
+    def put(self, uids, tokens_list) -> None:
+        for uid, toks in zip(uids, tokens_list):
+            self.state.extend(uid, toks)
+
+    def next_batch(self) -> Optional[RaggedBatch]:
+        uids, chunks, counts, starts, slots = [], [], [], [], []
+        budget = self.max_batch_tokens
+        for uid, seq in self.state.seqs.items():
+            if seq.done or seq.pending == 0:
+                continue
+            take = min(seq.pending, self.prefill_chunk, budget)
+            if take <= 0:
+                continue
+            chunk = seq.tokens[seq.seen_tokens:seq.seen_tokens + take]
+            uids.append(uid)
+            chunks.append(chunk)
+            counts.append(take)
+            starts.append(seq.seen_tokens)
+            slots.append(seq.slot)
+            budget -= take
+            if budget <= 0:
+                break
+        if not uids:
+            return None
+        width = max(counts)
+        padded = np.zeros((len(uids), width), np.int32)
+        for i, c in enumerate(chunks):
+            padded[i, :len(c)] = c
+        return RaggedBatch(uids=uids, token_ids=padded,
+                           token_counts=np.asarray(counts, np.int32),
+                           start_positions=np.asarray(starts, np.int32),
+                           slots=np.asarray(slots, np.int32))
+
+    def mark_scheduled(self, batch: RaggedBatch) -> None:
+        for uid, n in zip(batch.uids, batch.token_counts):
+            self.state.seqs[uid].seen_tokens += int(n)

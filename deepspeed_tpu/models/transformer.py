@@ -166,6 +166,23 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 AttentionFn = Callable[..., jax.Array]
 
 
+def resolve_remat_policy(name: Optional[str]):
+    """Map config policy names (ActivationCheckpointingConfig.policy) to
+    jax.checkpoint policies; 'full'/None -> save nothing extra."""
+    policies = {
+        "none": None,
+        "full": None,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_with_no_batch_dims_saveable":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name is not None and name not in policies:
+        raise ValueError(f"unknown remat policy '{name}'; "
+                         f"known: {sorted(policies)}")
+    return policies.get(name)
+
+
 # ---------------------------------------------------------------------------
 # Block
 # ---------------------------------------------------------------------------
@@ -186,9 +203,11 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
     return out
 
 
-def _attention_block(cfg: DecoderConfig, p: Params, x: jax.Array,
-                     sin, cos, attn_fn: AttentionFn) -> jax.Array:
-    b, t, d = x.shape
+def qkv_project(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared projection for training and KV-cached inference:
+    x [B,t,D] -> q [B,t,H,Dh], k/v [B,t,KvH,Dh] with bias + RoPE applied."""
+    d = x.shape[-1]
     q = jnp.einsum("btd,dhk->bthk", x,
                    p["wq"].reshape(d, cfg.num_heads, cfg.head_dim))
     k = jnp.einsum("btd,dhk->bthk", x,
@@ -202,12 +221,23 @@ def _attention_block(cfg: DecoderConfig, p: Params, x: jax.Array,
     if cfg.pos_emb == "rope":
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-    out = attn_fn(q, k, v)
+    return q, k, v
+
+
+def attn_out_project(cfg: DecoderConfig, p: Params, out: jax.Array
+                     ) -> jax.Array:
+    d = cfg.hidden_size
     out = jnp.einsum("bthk,hkd->btd", out,
                      p["wo"].reshape(cfg.num_heads, cfg.head_dim, d))
     if "bo" in p:
         out = out + p["bo"]
     return out
+
+
+def _attention_block(cfg: DecoderConfig, p: Params, x: jax.Array,
+                     sin, cos, attn_fn: AttentionFn) -> jax.Array:
+    q, k, v = qkv_project(cfg, p, x, sin, cos)
+    return attn_out_project(cfg, p, attn_fn(q, k, v))
 
 
 def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
@@ -297,15 +327,13 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
 # Forward
 # ---------------------------------------------------------------------------
 
-def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
-            attn_fn: AttentionFn = dot_product_attention,
-            moe_fn: Optional[Callable] = None,
-            positions: Optional[jax.Array] = None,
-            remat_policy: Optional[str] = None,
-            with_aux: bool = False
-            ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
-    """tokens: [B, T] int32 → logits [B, T, V] (fp32); with ``with_aux``
-    returns (logits, summed MoE aux loss).
+def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
+                   attn_fn: AttentionFn = dot_product_attention,
+                   moe_fn: Optional[Callable] = None,
+                   positions: Optional[jax.Array] = None,
+                   remat_policy: Optional[str] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, T] int32 → (final-norm hidden [B, T, D], MoE aux loss).
 
     Layers applied with ``lax.scan`` over the stacked pytree; optional
     ``jax.checkpoint`` per block (the reference's activation checkpointing
@@ -328,27 +356,92 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
         return out, aux
 
     if remat_policy and remat_policy != "none":
-        policies = {
-            "full": None,
-            "dots_saveable": jax.checkpoint_policies.dots_saveable,
-            "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
-            "dots_with_no_batch_dims_saveable":
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        }
-        policy = policies.get(remat_policy)
-        body = jax.checkpoint(body, policy=policy)
+        body = jax.checkpoint(body, policy=resolve_remat_policy(remat_policy))
 
     x, aux = lax.scan(body, x, params["layers"])
     x = _norm(cfg, params["final_norm"], x)
+    return x, jnp.sum(aux)
+
+
+def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Final projection: hidden [B,T,D] → logits [B,T,V] fp32."""
     if cfg.tie_embeddings:
-        logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
-                            preferred_element_type=jnp.float32)
-    else:
-        logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
-                            preferred_element_type=jnp.float32)
+        return jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
+def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
+            attn_fn: AttentionFn = dot_product_attention,
+            moe_fn: Optional[Callable] = None,
+            positions: Optional[jax.Array] = None,
+            remat_policy: Optional[str] = None,
+            with_aux: bool = False
+            ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """tokens → logits [B,T,V] fp32 (with_aux: plus MoE aux loss)."""
+    x, aux = forward_hidden(cfg, params, tokens, attn_fn=attn_fn,
+                            moe_fn=moe_fn, positions=positions,
+                            remat_policy=remat_policy)
+    logits = lm_logits(cfg, params, x)
     if with_aux:
-        return logits, jnp.sum(aux)
+        return logits, aux
     return logits
+
+
+def _pick_chunk(t: int, b: int, v: int,
+                budget_bytes: int = 128 * 1024 * 1024) -> int:
+    """Largest divisor of T whose fp32 logits chunk fits the budget."""
+    best = 1
+    for c in range(1, t + 1):
+        if t % c == 0 and b * c * v * 4 <= budget_bytes:
+            best = c
+    return best
+
+
+def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
+                          targets: jax.Array, ignore_index: int = -100,
+                          chunk_size: Optional[int] = None
+                          ) -> jax.Array:
+    """Token-mean CE without materializing [B,T,V] logits.
+
+    TPU-native equivalent of the reference's tiled logits-loss
+    (runtime/sequence_parallel/ulysses_sp.py:TiledFusedLogitsLoss:960):
+    the sequence is scanned in chunks with ``jax.checkpoint`` on the chunk
+    body, so backward recomputes each chunk's logits and peak memory is
+    one chunk — the difference between OOM and training for 128k vocabs.
+    """
+    b, t, d = x.shape
+    v = cfg.vocab_size
+    chunk = chunk_size or _pick_chunk(t, b, v)
+    if chunk >= t:
+        return cross_entropy_loss(lm_logits(cfg, params, x), targets,
+                                  ignore_index)
+    w = params["embed"]["tokens"] if cfg.tie_embeddings else params["lm_head"]
+    nc = t // chunk
+    xs = jnp.moveaxis(x.reshape(b, nc, chunk, d), 1, 0)       # [nc,B,C,D]
+    ts = jnp.moveaxis(targets.reshape(b, nc, chunk), 1, 0)    # [nc,B,C]
+
+    @jax.checkpoint
+    def body(carry, xc_tc):
+        nll_sum, cnt = carry
+        xc, tc = xc_tc
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bcd,vd->bcv", xc, w,
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("bcd,dv->bcv", xc, w,
+                                preferred_element_type=jnp.float32)
+        mask = tc != ignore_index
+        safe = jnp.where(mask, tc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = jnp.sum((logz - gold) * mask)
+        return (nll_sum + nll, cnt + jnp.sum(mask)), None
+
+    (nll, cnt), _ = lax.scan(body, (jnp.zeros((), jnp.float32),
+                                    jnp.zeros((), jnp.int32)), (xs, ts))
+    return nll / jnp.maximum(cnt, 1)
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
@@ -362,6 +455,88 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     gold = jnp.take_along_axis(logits, safe_targets[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
     return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached forward (inference; reference: inference_context.h KV rings +
+# inference/v2 blocked KV — here a static-shape cache updated in place)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int,
+                  dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(cfg: DecoderConfig, p: Params, x, sin, cos,
+                      k_cache, v_cache, cache_len):
+    """One block's attention against the cache; returns (out, k_new, v_new).
+
+    x: [B, t, D] new tokens; k_cache/v_cache: [B, Tmax, KvH, Dh];
+    cache_len: scalar int32 — tokens already cached.
+    """
+    b, t, d = x.shape
+    q, k, v = qkv_project(cfg, p, x, sin, cos)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0))
+
+    # attend over the whole (static) cache with a validity+causal mask
+    tmax = k_cache.shape[1]
+    kvh, dh = cfg.kv_heads, cfg.head_dim
+    groups = cfg.num_heads // kvh
+    qg = q.reshape(b, t, kvh, groups, dh)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg,
+                        k_cache.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(dh)
+    qpos = cache_len + jnp.arange(t)
+    kpos = jnp.arange(tmax)
+    mask = qpos[:, None] >= kpos[None, :]
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_cache)
+    out = out.reshape(b, t, cfg.num_heads, dh)
+    return attn_out_project(cfg, p, out), k_cache, v_cache
+
+
+def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
+                       cache: Params, cache_len: jax.Array,
+                       moe_fn: Optional[Callable] = None
+                       ) -> Tuple[jax.Array, Params]:
+    """tokens: [B, t] (prefill t>1 or decode t==1) → (logits of the LAST
+    position [B, V] fp32, updated cache). cache_len: tokens already held.
+    """
+    b, t = tokens.shape
+    x = params["embed"]["tokens"][tokens]
+    positions = cache_len + jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["pos"][positions]
+        sin = cos = jnp.zeros((b, t, 0), x.dtype)
+    else:
+        sin, cos = rope_table(cfg, positions)
+
+    def body(carry, layer):
+        x = carry
+        layer_params, k_c, v_c = layer
+        h_in = _norm(cfg, layer_params["ln1"], x)
+        attn_out, k_c, v_c = _cached_attention(
+            cfg, layer_params["attn"], h_in, sin, cos, k_c, v_c, cache_len)
+        h = x + attn_out
+        normed = _norm(cfg, layer_params["ln2"], h)
+        if cfg.num_experts and moe_fn is not None:
+            ff, _ = moe_fn(cfg, layer_params["moe"], normed)
+        else:
+            ff = _mlp(cfg, layer_params["mlp"], normed)
+        return h + ff, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _norm(cfg, params["final_norm"], x[:, -1:])
+    logits = lm_logits(cfg, params, x)[:, 0]
+    return logits, {"k": k_new, "v": v_new}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,407 @@
+"""Flash attention — Pallas TPU kernel.
+
+The TPU-native replacement for the reference's fused attention kernels
+(csrc/transformer/inference/csrc/softmax.cu + the blocked_flash bindings
+under deepspeed/inference/v2/kernels/ragged_ops/). Blockwise online-softmax
+attention: the [T, T] score matrix is never materialized in HBM — each
+(query-block, kv-block) tile lives only in VMEM — so backward needs no
+saved probabilities, just the per-row logsumexp (the same residual layout
+flash-attention-2 uses).
+
+Layout: heads are folded into the grid's leading axis ([B*H, T, D]); GQA
+maps query-head index -> kv-head index inside the BlockSpec index maps, so
+K/V are never repeated in memory. fp32 accumulation on the MXU
+(preferred_element_type), bf16 inputs.
+
+Falls back to the XLA reference implementation (models.transformer.
+dot_product_attention) off-TPU or for shapes the kernel doesn't cover.
+"""
+
+import functools
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, causal: bool, block_k: int, q_offset: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    d = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32)                       # [BQ, D]
+    q_start = qi * block_q + q_offset
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # only blocks that intersect the causal triangle
+        num_kb_dyn = lax.min(
+            jnp.int32(num_kb),
+            lax.div(q_start + block_q + block_k - 1, jnp.int32(block_k)))
+    else:
+        num_kb_dyn = jnp.int32(num_kb)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = lax.dot_general(q, k_blk.astype(jnp.float32),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = kb * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=1)                        # [BQ]
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m[:, None])
+        # rows with no live key yet: new_m == -inf -> p must be 0
+        alive = new_m > _NEG_INF / 2
+        p = jnp.where(alive[:, None], p, 0.0)
+        corr = jnp.where(alive, jnp.exp(m - new_m), 0.0)
+        acc = acc * corr[:, None] + lax.dot_general(
+            p, v_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l = l * corr + jnp.sum(p, axis=1)
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, num_kb_dyn, body, (acc0, m0, l0))
+
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    # lse layout [BH, 1, TQ]: full row resident per bh, each qi program
+    # writes its slice (satisfies the (8,128) tile rule via dim equality)
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
+        m > _NEG_INF / 2, m + jnp.log(safe_l), _NEG_INF)
+
+
+def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, interpret):
+    bh, tq, d = q.shape
+    bkv, tk, _ = k.shape
+    g = bh // bkv
+    grid = (bh, tq // block_q)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, q_offset=q_offset),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, g=g: (lax.div(b, g), 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, g=g: (lax.div(b, g), 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-attention-2 style: recompute p from q,k + lse)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, causal: bool, block_k: int,
+                   q_offset: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    d = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    q_start = qi * block_q + q_offset
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kb = seq_k // block_k
+    if causal:
+        num_kb_dyn = lax.min(
+            jnp.int32(num_kb),
+            lax.div(q_start + block_q + block_k - 1, jnp.int32(block_k)))
+    else:
+        num_kb_dyn = jnp.int32(num_kb)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = kb * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq = dq + lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dq
+
+    dq = lax.fori_loop(0, num_kb_dyn, body,
+                       jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, q_offset: int):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    seq_q = q_ref.shape[1]
+    d = k_ref.shape[2]
+
+    k_blk = k_ref[0].astype(jnp.float32)                   # [BK, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_start = ki * block_k
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    num_qb = seq_q // block_q
+    if causal:
+        # first q block whose END reaches this k block's start
+        first_qb = lax.max(
+            jnp.int32(0),
+            lax.div(k_start - q_offset - block_q + 1 + block_q - 1,
+                    jnp.int32(block_q)))
+    else:
+        first_qb = jnp.int32(0)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + q_offset + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
+         interpret):
+    bh, tq, d = q.shape
+    bkv, tk, _ = k.shape
+    g = bh // bkv
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]                      # [BH, 1, TQ]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, q_offset=q_offset),
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, g=g: (lax.div(b, g), 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, g=g: (lax.div(b, g), 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per q-head, summed over the GQA group afterwards
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, q_offset=q_offset),
+        grid=(bh, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, g=g: (lax.div(b, g), i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, g=g: (lax.div(b, g), i, 0)),
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if g > 1:
+        dk = dk_h.reshape(bkv, g, tk, d).sum(axis=1)
+        dv = dv_h.reshape(bkv, g, tk, d).sum(axis=1)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, q_offset, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
+                  block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
+                    block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, q_offset, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, g,
+                      1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
+                      block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _supported(tq, tk, d, block_q, block_k) -> bool:
+    return (tq % block_q == 0 and tk % block_k == 0 and
+            tq >= block_q and tk >= block_k and d <= 256)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    q_offset: int = 0,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Drop-in ``attn_fn``: q [B,T,H,D], k/v [B,T,KvH,D] → [B,T,H,D].
+
+    Uses the Pallas kernel on TPU (or interpret mode elsewhere when forced
+    via ``interpret=True``); falls back to the XLA reference path for
+    unsupported shapes.
+    """
+    b, tq, h, d = q.shape
+    _, tk, kvh, _ = k.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = block_q or min(DEFAULT_BLOCK_Q, tq)
+    bk = block_k or min(DEFAULT_BLOCK_K, tk)
+    if not _supported(tq, tk, d, bq, bk) or h % kvh:
+        from deepspeed_tpu.models.transformer import dot_product_attention
+        return dot_product_attention(q, k, v, causal=causal,
+                                     q_offset=q_offset)
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
+    out = _flash(qf, kf, vf, causal, q_offset, bq, bk, interpret)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                            causal: bool = True,
+                            q_offset: int = 0,
+                            **kw) -> jax.Array:
+    """Mesh-aware flash attention for use inside the jitted train step.
+
+    A bare ``pallas_call`` has no SPMD partitioning rule — under automatic
+    sharding XLA would replicate q/k/v onto every chip. This wrapper
+    shard_maps the kernel over the batch axes ('data','expert') and, when
+    head counts divide, the head axes ('model' for TP and 'seq' for
+    Ulysses — sharding heads over 'seq' after a sequence-sharded input IS
+    the Ulysses all-to-all, reference sequence/layer.py:331, emitted here
+    by the shard_map in_specs resharding). Falls back to the XLA attention
+    when the local shapes don't meet the kernel's constraints.
+    """
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.parallel.mesh import ZERO_AXES, get_mesh, has_mesh
+
+    if not has_mesh():
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               **kw)
+    mesh = get_mesh()
+    b, tq, h, d = q.shape
+    kvh = k.shape[2]
+
+    batch_axes = tuple(a for a in ZERO_AXES
+                       if mesh.shape[a] > 1 and b % mesh.shape[a] == 0)
+    bdiv = 1
+    for a in batch_axes:
+        bdiv *= mesh.shape[a]
+    head_axes = tuple(a for a in ("model", "seq") if mesh.shape[a] > 1)
+    hdiv = 1
+    for a in head_axes:
+        hdiv *= mesh.shape[a]
+    # GQA grouping is only correct when q AND kv heads shard identically
+    if head_axes and (h % hdiv or kvh % hdiv):
+        head_axes = tuple(a for a in ("model",) if mesh.shape[a] > 1)
+        hdiv = mesh.shape["model"] if head_axes else 1
+        if head_axes and (h % hdiv or kvh % hdiv):
+            head_axes, hdiv = (), 1
+    if b % max(bdiv, 1):
+        batch_axes, bdiv = (), 1
+
+    manual = set(batch_axes) | set(head_axes)
+    if not manual:
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               **kw)
+
+    bspec = batch_axes if len(batch_axes) > 1 else \
+        (batch_axes[0] if batch_axes else None)
+    hspec = head_axes if len(head_axes) > 1 else \
+        (head_axes[0] if head_axes else None)
+    spec = P(bspec, None, hspec, None)
+
+    local = partial(flash_attention, causal=causal, q_offset=q_offset, **kw)
+    # check_vma=False: pallas_call outputs carry no varying-axes metadata;
+    # the kernel is embarrassingly parallel over the manual axes anyway
+    fn = jax.shard_map(lambda a, b_, c: local(a, b_, c),
+                       mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=manual, check_vma=False)
+    return fn(q, k, v)

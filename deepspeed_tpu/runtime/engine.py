@@ -67,6 +67,12 @@ class ModelSpec:
     flops_per_token: Optional[float] = None
     #: tokens per sample (seq len) for throughput accounting
     tokens_per_sample: Optional[int] = None
+    #: pipeline-parallel loss over STACKED microbatches [M, B, ...] —
+    #: set by the factory when pipeline.stages > 1; the engine then runs
+    #: the whole microbatch set in one call (reference PipelineEngine
+    #: train_batch:337 — forward()/backward() are not supported, matching
+    #: the reference's restriction)
+    pipeline_loss_fn: Optional[Callable[[Pytree, Batch, jax.Array], Any]] = None
 
 
 class DeepSpeedTPUEngine:
@@ -249,6 +255,28 @@ class DeepSpeedTPUEngine:
     def _build_step_functions(self) -> None:
         gas = int(self.config.gradient_accumulation_steps)
 
+        if self.model.pipeline_loss_fn is not None:
+            # pipeline path: the schedule consumes all M microbatches in
+            # one traced program; loss is already the mean over them
+            def pipe_step(params, opt_state, scaler, batch, step, rng):
+                def scaled(p):
+                    loss = self.model.pipeline_loss_fn(p, batch, rng)
+                    return loss * scaler.scale, loss
+                grads, loss = jax.grad(scaled, has_aux=True)(params)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, self.plan.grad_shardings())
+                params, opt_state, scaler, metrics = self._apply_update(
+                    params, opt_state, scaler, grads, step, 1)
+                metrics["loss"] = loss
+                return params, opt_state, scaler, metrics
+
+            self._fused_step = jax.jit(pipe_step, donate_argnums=(0, 1, 2))
+            self._grad_step = None
+            self._acc_add = None
+            self._update_step = None
+            self._rng = jax.random.PRNGKey(self.config.seed + 1)
+            return
+
         # fused train_batch step: batch leaves have leading [gas, ...] dim
         def fused_step(params, opt_state, scaler, batch, step, rng):
             def micro(carry, mb):
@@ -316,9 +344,17 @@ class DeepSpeedTPUEngine:
     def forward(self, batch: Batch) -> jax.Array:
         """Compute loss (+ cache grads for the following backward).
 
+        Not supported under pipeline parallelism — use train_batch
+        (reference: PipelineEngine raises the same way, pipe/engine.py).
+
         The reference runs autograd lazily; jax computes loss and grads in
         one fused call here — ``backward`` then folds the cached grads into
         the accumulator, preserving the 3-call API exactly."""
+        if self._grad_step is None:
+            raise RuntimeError(
+                "forward()/backward()/step() are not supported with "
+                "pipeline parallelism; use train_batch() "
+                "(reference pipe/engine.py restriction)")
         self._rng, sub = jax.random.split(self._rng)
         batch = self._place_batch(batch)
         loss, grads = self._grad_step(self.params, batch,

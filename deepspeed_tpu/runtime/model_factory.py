@@ -22,12 +22,23 @@ from deepspeed_tpu.models.transformer import (DecoderConfig,
 
 def select_attention(ds_cfg: DeepSpeedTPUConfig):
     """Pick the attention implementation from the parallel-topology config
-    (reference: DistributedAttention wrapping sequence/layer.py:331)."""
+    (reference: DistributedAttention wrapping sequence/layer.py:331).
+
+    The local attention is the Pallas flash kernel on TPU (reference's
+    kernel-injection attention, csrc/transformer/inference) — it transparently
+    falls back to the XLA path off-TPU or for unsupported shapes."""
+    import jax as _jax
+    on_tpu = _jax.default_backend() == "tpu"
     sp = ds_cfg.sequence_parallel
+    if sp.size > 1 and sp.mode == "ring":
+        from deepspeed_tpu.parallel.ring import ring_attention
+        return partial(ring_attention, axis_name="seq")
+    if on_tpu:
+        # mesh-aware Pallas flash kernel; its shard_map head-sharding over
+        # ('model','seq') IS the Ulysses all-to-all when sp > 1
+        from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
+        return flash_attention_sharded
     if sp.size > 1:
-        if sp.mode == "ring":
-            from deepspeed_tpu.parallel.ring import ring_attention
-            return partial(ring_attention, axis_name="seq")
         from deepspeed_tpu.parallel.ulysses import distributed_attention
         return partial(distributed_attention, axis_name="seq")
     return dot_product_attention
@@ -69,22 +80,50 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         else:
             labels = jnp.concatenate(
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
-        if moe_fn is not None:
-            logits, aux = transformer.forward(
-                dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=moe_fn,
-                remat_policy=remat, with_aux=True)
-            return cross_entropy_loss(logits, labels) + aux
-        logits = transformer.forward(dec_cfg, params, tokens,
-                                     attn_fn=attn_fn, moe_fn=moe_fn,
-                                     remat_policy=remat)
-        return cross_entropy_loss(logits, labels)
+        hidden, aux = transformer.forward_hidden(
+            dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=moe_fn,
+            remat_policy=remat)
+        loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
+                                                 labels)
+        return loss + aux if moe_fn is not None else loss
 
     tp = ds_cfg.tensor_parallel.enabled
     specs = transformer.partition_specs(
         dec_cfg, zero_stage=ds_cfg.zero_optimization.stage, tp=tp)
 
+    pipeline_loss_fn = None
+    stages = ds_cfg.pipeline.stages
+    if stages > 1:
+        from deepspeed_tpu.runtime.pipe.pipeline import (
+            pipeline_partition_specs, pipelined_loss)
+        assert dec_cfg.num_layers % stages == 0, (
+            f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
+            f"stages {stages}")
+        specs = pipeline_partition_specs(specs, stages)
+
+        # the pipeline schedule is itself a shard_map; a nested
+        # shard_map'd flash kernel can't run inside it — use the XLA
+        # attention there (pallas-inside-pipeline is future work)
+        from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
+        pipe_attn = dot_product_attention \
+            if attn_fn is flash_attention_sharded else attn_fn
+
+        def pipeline_loss_fn(params, batch, rng):
+            tokens = batch["input_ids"]            # [M, B, T]
+            if "labels" in batch:
+                labels = batch["labels"]
+            else:
+                labels = jnp.concatenate(
+                    [tokens[:, :, 1:],
+                     jnp.full_like(tokens[:, :, :1], -100)], axis=2)
+            return pipelined_loss(dec_cfg, params, tokens, labels,
+                                  attn_fn=pipe_attn, moe_fn=moe_fn,
+                                  remat_policy=remat or "full",
+                                  num_stages=stages)
+
     n = dec_cfg.num_params()
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn,
                      partition_specs=specs,
                      flops_per_token=6.0 * n,
-                     tokens_per_sample=dec_cfg.max_seq_len)
+                     tokens_per_sample=dec_cfg.max_seq_len,
+                     pipeline_loss_fn=pipeline_loss_fn)

@@ -1,0 +1,168 @@
+"""Pipeline parallelism over the 'pipe' mesh axis.
+
+Reference: ``deepspeed/runtime/pipe`` — ``PipelineModule`` (module.py:86)
+partitions a layer list across stages, ``PipelineEngine`` (engine.py:60)
+executes a hand-written instruction schedule (1F1B, schedule.py:189) with
+explicit P2P sends (p2p.py:46). The TPU-native re-design:
+
+- the **stacked layer pytree** ([L, ...] leaves — models/transformer.py)
+  is sharded on its leading axis over 'pipe': stage s holds layers
+  [s·L/S, (s+1)·L/S) — exactly PipelineModule's uniform partition;
+- the schedule is a **collective-permute pipeline** inside a
+  partial-manual ``shard_map`` over 'pipe': M microbatches flow through
+  S stages in M+S-1 ticks, activations hopping stage→stage via
+  ``lax.ppermute`` (nearest-neighbour ICI, the P2P of p2p.py:46);
+- **backward is autodiff**: grad-of-ppermute is the reverse permute, so
+  reverse-mode AD yields the mirror-image backward schedule (GPipe-style
+  all-forward/all-backward; per-stage ``jax.checkpoint`` bounds activation
+  memory — the bubble fraction (S-1)/(M+S-1) matches 1F1B, which only
+  improves memory, already handled by remat);
+- embeddings/final-norm/head stay replicated across 'pipe'; every stage
+  computes the embed of its incoming tick and the loss runs once on the
+  collected last-stage outputs (tied-weight allreduce of module.py:454 is
+  subsumed by XLA's gradient psum over the replicated embed).
+
+Other mesh axes (data/expert for ZeRO, 'model' for TP, 'seq') remain
+*automatic* inside the shard_map, so pipeline composes with ZeRO/TP/SP.
+"""
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import transformer
+from deepspeed_tpu.models.transformer import DecoderConfig
+
+
+def pipeline_partition_specs(base_specs, stages: int):
+    """Add the 'pipe' sharding on the stacked-layer leading axis
+    (reference: PipelineModule partition by 'uniform', module.py:393)."""
+    if stages <= 1:
+        return base_specs
+
+    def add_pipe(spec):
+        entries = list(spec)
+        if entries:
+            assert entries[0] is None, f"layer dim already sharded: {spec}"
+            entries[0] = "pipe"
+        return P(*entries)
+
+    out = dict(base_specs)
+    out["layers"] = jax.tree.map(add_pipe, base_specs["layers"],
+                                 is_leaf=lambda x: isinstance(x, P))
+    return out
+
+
+def _stage_forward(cfg: DecoderConfig, local_layers, x, sin, cos,
+                   attn_fn, moe_fn, remat_policy: Optional[str]):
+    """Run this stage's L/S layers (scan, optional per-block remat)."""
+    block = partial(transformer.decoder_block, cfg, attn_fn=attn_fn,
+                    moe_fn=moe_fn)
+
+    def body(carry, layer_params):
+        out, aux = block(layer_params, carry, sin, cos)
+        return out, aux
+
+    if remat_policy and remat_policy != "none":
+        body = jax.checkpoint(
+            body, policy=transformer.resolve_remat_policy(remat_policy))
+    x, aux = lax.scan(body, x, local_layers)
+    return x, jnp.sum(aux)
+
+
+def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
+                   attn_fn=None, moe_fn=None,
+                   remat_policy: Optional[str] = None,
+                   mesh=None, num_stages: Optional[int] = None):
+    """tokens/labels: [M, B, T] stacked microbatches → scalar token-mean CE.
+
+    Must be called under jit with ``params['layers']`` sharded over 'pipe'
+    on the leading axis (pipeline_partition_specs).
+    """
+    from deepspeed_tpu.parallel.mesh import get_mesh
+    mesh = mesh or get_mesh()
+    S = num_stages or mesh.shape["pipe"]
+    attn_fn = attn_fn or transformer.dot_product_attention
+    M, b, t = tokens.shape
+    d = cfg.hidden_size
+
+    def per_stage(local_layers, embed, final_norm, head, tokens, labels):
+        sid = lax.axis_index("pipe")
+        positions = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+        if cfg.pos_emb == "rope":
+            sin, cos = transformer.rope_table(cfg, positions)
+        else:
+            sin = cos = jnp.zeros((b, t, 0), jnp.float32)
+
+        def embed_mb(tok):
+            x = embed["tokens"][tok]
+            if cfg.pos_emb == "learned":
+                x = x + embed["pos"][positions]
+            return x
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        buf = jnp.zeros((b, t, d), embed["tokens"].dtype)
+        buf = lax.pcast(buf, ("pipe",), to="varying")
+        collected = jnp.zeros((M, b, t, d), jnp.float32)
+        collected = lax.pcast(collected, ("pipe",), to="varying")
+        aux_total = lax.pcast(jnp.zeros((), jnp.float32), ("pipe",),
+                              to="varying")
+
+        for step in range(M + S - 1):
+            mb_in = min(step, M - 1)           # microbatch entering stage 0
+            x_in = jnp.where(sid == 0, embed_mb(tokens[mb_in]), buf)
+            x_out, aux = _stage_forward(cfg, local_layers, x_in, sin, cos,
+                                        attn_fn, moe_fn, remat_policy)
+            valid = jnp.logical_and(step >= sid,
+                                    step - sid < M).astype(jnp.float32)
+            # each stage's aux covers only its own L/S layers, so the psum
+            # over 'pipe' below reassembles the full-model layer sum per
+            # microbatch; dividing by M gives the per-microbatch mean,
+            # matching the non-pipeline loss exactly
+            aux_total = aux_total + aux * valid / M
+            mb_out = step - (S - 1)            # microbatch leaving last stage
+            if 0 <= mb_out < M:
+                keep = (sid == S - 1).astype(x_out.dtype)
+                collected = collected.at[mb_out].set(
+                    x_out.astype(jnp.float32) * keep)
+            buf = lax.ppermute(x_out, "pipe", perm)
+
+        # share last-stage activations with every stage (psum of one-hot
+        # contribution), then compute the loss identically everywhere —
+        # keeps the program SPMD and the loss replicated for the engine
+        collected = lax.psum(collected, "pipe")
+        xs = collected.reshape(M * b, t, d).astype(embed["tokens"].dtype)
+        norm_params = {"final_norm": final_norm, "embed": embed}
+        if head is not None:
+            norm_params["lm_head"] = head
+        xn = transformer._norm(cfg, final_norm, xs)
+        loss = transformer.chunked_cross_entropy(
+            cfg, norm_params, xn, labels.reshape(M * b, t))
+        aux_all = lax.psum(aux_total, "pipe")
+        return loss + aux_all
+
+    head = params.get("lm_head")
+    base_specs = (
+        jax.tree.map(lambda _: P("pipe"), params["layers"]),
+        jax.tree.map(lambda _: P(), params["embed"]),
+        jax.tree.map(lambda _: P(), params["final_norm"]),
+    )
+    if head is None:
+        def entry(local_layers, embed, final_norm, tokens, labels):
+            return per_stage(local_layers, embed, final_norm, None,
+                             tokens, labels)
+        fn = jax.shard_map(entry, mesh=mesh,
+                           in_specs=base_specs + (P(), P()),
+                           out_specs=P(), axis_names={"pipe"})
+        return fn(params["layers"], params["embed"], params["final_norm"],
+                  tokens, labels)
+    fn = jax.shard_map(per_stage, mesh=mesh,
+                       in_specs=base_specs + (P(), P(), P()),
+                       out_specs=P(), axis_names={"pipe"})
+    return fn(params["layers"], params["embed"], params["final_norm"],
+              head, tokens, labels)
