@@ -1,0 +1,162 @@
+// Host-side vectorized Adam/AdamW for ZeRO-Offload.
+//
+// TPU-native equivalent of the reference's CPU optimizer
+// (csrc/adam/cpu_adam_impl.cpp with AVX512/AVX256 intrinsics via
+// csrc/includes/simd.h). Differences: instead of hand-written AVX
+// intrinsics we give the compiler contiguous fp32 loops (-O3 -ffast-math
+// auto-vectorizes to the host ISA — portable across the x86/ARM TPU-VM
+// fleet) and parallelize across a persistent std::thread pool, matching
+// the reference's per-tensor-group threading.
+//
+// C ABI (ctypes-friendly): all state is caller-owned flat fp32 buffers.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false), pending_(0) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> job;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            cv_.wait(lk, [this] { return stop_ || !jobs_.empty(); });
+            if (stop_ && jobs_.empty()) return;
+            job = std::move(jobs_.back());
+            jobs_.pop_back();
+          }
+          job();
+          if (--pending_ == 0) {
+            std::unique_lock<std::mutex> lk(mu_);
+            done_cv_.notify_all();
+          }
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void run(std::function<void()> job) {
+    ++pending_;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      jobs_.push_back(std::move(job));
+    }
+    cv_.notify_one();
+  }
+
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::vector<std::function<void()>> jobs_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  bool stop_;
+  std::atomic<int> pending_;
+};
+
+ThreadPool& pool() {
+  static ThreadPool p(std::max(1u, std::thread::hardware_concurrency() / 2));
+  return p;
+}
+
+inline void adam_span(float* __restrict p, const float* __restrict g,
+                      float* __restrict m, float* __restrict v, int64_t n,
+                      float lr, float beta1, float beta2, float eps,
+                      float weight_decay, bool adamw, float bc1, float bc2) {
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+  // single contiguous loop: clang/gcc vectorize this to the native ISA
+  for (int64_t i = 0; i < n; ++i) {
+    float grad = g[i];
+    if (!adamw && weight_decay != 0.0f) grad += weight_decay * p[i];
+    float mi = beta1 * m[i] + one_m_b1 * grad;
+    float vi = beta2 * v[i] + one_m_b2 * grad * grad;
+    m[i] = mi;
+    v[i] = vi;
+    float update = (mi / bc1) / (std::sqrt(vi / bc2) + eps);
+    if (adamw && weight_decay != 0.0f) update += weight_decay * p[i];
+    p[i] -= lr * update;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// One fused Adam sweep over a flat fp32 buffer, parallelized across the
+// host thread pool (reference ds_adam_step, csrc/adam/cpu_adam_impl.cpp).
+void ds_host_adam_step(float* params, const float* grads, float* exp_avg,
+                       float* exp_avg_sq, int64_t n, int32_t step, float lr,
+                       float beta1, float beta2, float eps,
+                       float weight_decay, int32_t adamw_mode) {
+  const float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  const float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  const int nthreads = pool().size();
+  const int64_t chunk = std::max<int64_t>((n + nthreads - 1) / nthreads,
+                                          1 << 16);
+  for (int64_t off = 0; off < n; off += chunk) {
+    const int64_t len = std::min(chunk, n - off);
+    pool().run([=] {
+      adam_span(params + off, grads + off, exp_avg + off, exp_avg_sq + off,
+                len, lr, beta1, beta2, eps, weight_decay, adamw_mode != 0,
+                bc1, bc2);
+    });
+  }
+  pool().wait();
+}
+
+// bf16 (stored as uint16) -> fp32 widening copy, vectorizable; used when
+// grads arrive from device in bf16 (reference: cpu_adam half paths).
+void ds_bf16_to_f32(const uint16_t* src, float* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits = static_cast<uint32_t>(src[i]) << 16;
+    std::memcpy(&dst[i], &bits, sizeof(float));
+  }
+}
+
+// fp32 -> bf16 round-to-nearest-even (matches XLA's convert).
+void ds_f32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits;
+    std::memcpy(&bits, &src[i], sizeof(float));
+    uint32_t lsb = (bits >> 16) & 1u;
+    uint32_t rounded = bits + 0x7FFFu + lsb;
+    dst[i] = static_cast<uint16_t>(rounded >> 16);
+  }
+}
+
+// L2 norm over a flat buffer (overflow/clip support on host).
+double ds_l2_norm_sq(const float* x, int64_t n) {
+  double acc = 0.0;
+  for (int64_t i = 0; i < n; ++i) acc += static_cast<double>(x[i]) * x[i];
+  return acc;
+}
+
+}  // extern "C"

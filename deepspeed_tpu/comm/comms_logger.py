@@ -70,6 +70,12 @@ class CommsLogger:
             logger.info("comm op: %s | size: %s | axis: %s", op_name,
                         convert_size(size_bytes), axis)
 
+    def reset(self) -> None:
+        self.comms_dict.clear()
+
+    def has_records(self, op_name: str) -> bool:
+        return op_name in self.comms_dict
+
     def log_summary(self) -> None:
         lines = [f"{'op':<18}{'size':>12}{'count':>8}{'total ms':>12}"]
         for op_name, sizes in sorted(self.comms_dict.items()):

@@ -129,8 +129,15 @@ class InferenceEngineTPU:
 
     def _new_cache(self, batch: int, max_len: int):
         cache = init_kv_cache(self.model_config, batch, max_len, self.dtype)
-        return jax.device_put(cache, {"k": self._cache_sh,
-                                      "v": self._cache_sh})
+        sh = self._cache_sh
+        dp = self.mesh.shape["data"] * self.mesh.shape["expert"]
+        if batch % dp:
+            # batch doesn't divide the DP axes (e.g. serving a single
+            # prompt on a training mesh): replicate the batch dim
+            spec = sh.spec
+            sh = NamedSharding(self.mesh,
+                               P(None, None, None, *spec[3:]))
+        return jax.device_put(cache, {"k": sh, "v": sh})
 
     def generate(self, input_ids, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k: int = 0,
@@ -151,7 +158,7 @@ class InferenceEngineTPU:
         done = np.zeros((b,), bool)
         cur_len = t
         sampler = self._sampler(temperature, top_k, top_p)
-        for _ in range(max_new_tokens):
+        for i in range(max_new_tokens):
             rng, sub = jax.random.split(rng)
             nxt = sampler(logits, sub)
             nxt_np = np.asarray(jax.device_get(nxt))
@@ -159,7 +166,9 @@ class InferenceEngineTPU:
                 nxt_np = np.where(done, eos_token_id, nxt_np)
                 done |= nxt_np == eos_token_id
             out.append(nxt_np[:, None])
-            if eos_token_id is not None and done.all():
+            last = (i == max_new_tokens - 1) or \
+                (eos_token_id is not None and done.all())
+            if last:    # the next forward's logits would never be sampled
                 break
             logits, cache = self._step(
                 self.params, jnp.asarray(nxt_np[:, None]), cache,
@@ -176,9 +185,11 @@ class InferenceEngineTPU:
 
     def forward(self, input_ids) -> jax.Array:
         """Full-sequence logits (no cache) — parity with engine forward."""
-        from deepspeed_tpu.models.transformer import forward
-        return jax.jit(partial(forward, self.model_config))(
-            self.params, jnp.asarray(input_ids, jnp.int32))
+        if not hasattr(self, "_full_forward"):
+            from deepspeed_tpu.models.transformer import forward
+            self._full_forward = jax.jit(partial(forward, self.model_config))
+        return self._full_forward(self.params,
+                                  jnp.asarray(input_ids, jnp.int32))
 
 
 def init_inference(model: DecoderConfig, config=None, **kwargs

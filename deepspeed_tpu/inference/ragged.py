@@ -76,11 +76,14 @@ class DSStateManager:
 
     def extend(self, uid: int, token_ids) -> SequenceDescriptor:
         seq = self.get_or_create_sequence(uid)
-        seq.tokens.extend(int(t) for t in np.asarray(token_ids).reshape(-1))
-        needed = -(-len(seq.tokens) // self.allocator.block_size) \
-            - len(seq.blocks)
+        new = [int(t) for t in np.asarray(token_ids).reshape(-1)]
+        total = len(seq.tokens) + len(new)
+        needed = -(-total // self.allocator.block_size) - len(seq.blocks)
+        # allocate BEFORE mutating so an exhausted arena leaves the
+        # sequence untouched and the caller can retry safely
         if needed > 0:
             seq.blocks.extend(self.allocator.allocate(needed))
+        seq.tokens.extend(new)
         return seq
 
     def flush(self, uid: int) -> None:

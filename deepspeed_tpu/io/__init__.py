@@ -1,0 +1,1 @@
+from deepspeed_tpu.io.async_io import AsyncIOEngine  # noqa: F401

@@ -1,0 +1,96 @@
+"""Async NVMe/disk I/O — Python wrapper over csrc/async_io.cpp.
+
+Reference: the DeepNVMe stack (``csrc/aio/py_lib/py_ds_aio.cpp``,
+``ops/aio``, ``deepspeed/io/fast_file_writer.py``). Serves ZeRO-Infinity
+tensor swapping and fast checkpointing: submit non-blocking reads/writes
+of numpy buffers against files, overlap with compute, drain at a barrier.
+Falls back to a synchronous Python implementation without a toolchain.
+"""
+
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import is_native_available, load_async_io
+
+
+class AsyncIOEngine:
+    def __init__(self, num_threads: int = 4, o_direct: bool = False,
+                 use_native: Optional[bool] = None):
+        if use_native is None:
+            use_native = is_native_available()
+        self._native = None
+        self._fallback_jobs = []
+        if use_native:
+            self._lib = load_async_io()
+            self._native = self._lib.ds_aio_create(num_threads,
+                                                   1 if o_direct else 0)
+        #: keep submitted buffers alive until drain (the C engine reads
+        #: from the raw pointers)
+        self._pinned: Dict[int, np.ndarray] = {}
+        self._next = 0
+
+    def __del__(self):
+        try:
+            if self._native is not None:
+                self._lib.ds_aio_destroy(self._native)
+        except Exception:
+            pass
+
+    def _track(self, buf: np.ndarray) -> int:
+        self._next += 1
+        self._pinned[self._next] = buf
+        return self._next
+
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        buf = np.ascontiguousarray(buf)
+        tid = self._track(buf)
+        if self._native is not None:
+            self._lib.ds_aio_pwrite(self._native, path.encode(),
+                                    buf.ctypes.data, buf.nbytes, offset)
+        else:
+            t = threading.Thread(target=self._sync_write,
+                                 args=(path, buf, offset))
+            t.start()
+            self._fallback_jobs.append(t)
+        return tid
+
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        assert buf.flags["C_CONTIGUOUS"]
+        tid = self._track(buf)
+        if self._native is not None:
+            self._lib.ds_aio_pread(self._native, path.encode(),
+                                   buf.ctypes.data, buf.nbytes, offset)
+        else:
+            t = threading.Thread(target=self._sync_read,
+                                 args=(path, buf, offset))
+            t.start()
+            self._fallback_jobs.append(t)
+        return tid
+
+    @staticmethod
+    def _sync_write(path: str, buf: np.ndarray, offset: int) -> None:
+        with open(path, "r+b" if os.path.exists(path) else "wb") as fh:
+            fh.seek(offset)
+            fh.write(buf.tobytes())
+
+    @staticmethod
+    def _sync_read(path: str, buf: np.ndarray, offset: int) -> None:
+        with open(path, "rb") as fh:
+            fh.seek(offset)
+            data = fh.read(buf.nbytes)
+        buf[...] = np.frombuffer(data, dtype=buf.dtype).reshape(buf.shape)
+
+    def drain(self) -> int:
+        """Block until all in-flight ops complete; returns error count."""
+        if self._native is not None:
+            errs = int(self._lib.ds_aio_drain(self._native))
+        else:
+            for t in self._fallback_jobs:
+                t.join()
+            self._fallback_jobs.clear()
+            errs = 0
+        self._pinned.clear()
+        return errs

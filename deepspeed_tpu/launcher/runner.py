@@ -1,0 +1,129 @@
+"""Launcher — multi-host TPU-pod job runner.
+
+Reference: ``bin/deepspeed`` → ``launcher/runner.py`` (main:436, hostfile
+parsing:230–308) → per-node ``launcher/launch.py``:145. TPU translation:
+one process per HOST (not per chip — jax drives all local chips), the
+rendezvous is ``jax.distributed.initialize`` instead of
+torch.distributed, and remote spawn uses ssh (the PDSH/MPI runner family
+of multinode_runner.py collapses to one ssh runner because TPU pods are
+homogeneous by construction).
+
+Single-host: exec the script in-process env. Multi-host: parse a
+hostfile (same ``hostname slots=N`` grammar as the reference), export
+DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID and ssh-spawn
+``launch.py`` per host.
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(path: str) -> Dict[str, int]:
+    """Reference runner.py:_parse_hostfile:243 — 'host slots=N' lines."""
+    hosts: Dict[str, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            name = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            if name in hosts:
+                raise ValueError(f"duplicate host {name} in hostfile")
+            hosts[name] = slots
+    if not hosts:
+        raise ValueError(f"empty hostfile {path}")
+    return hosts
+
+
+def filter_hosts(hosts: Dict[str, int], include: str = "",
+                 exclude: str = "") -> Dict[str, int]:
+    """Reference include/exclude filters (runner.py:310–399), host-level
+    subset (slot-level filtering is meaningless when one process drives
+    all local chips)."""
+    out = dict(hosts)
+    if include:
+        names = set(include.split("@"))
+        out = {h: s for h, s in out.items() if h in names}
+    if exclude:
+        names = set(exclude.split("@"))
+        out = {h: s for h, s in out.items() if h not in names}
+    if not out:
+        raise ValueError("no hosts left after include/exclude filtering")
+    return out
+
+
+def build_launch_env(coordinator: str, num_processes: int, process_id: int,
+                     base_env: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env["DSTPU_COORDINATOR"] = coordinator
+    env["DSTPU_NUM_PROCESSES"] = str(num_processes)
+    env["DSTPU_PROCESS_ID"] = str(process_id)
+    return env
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu launcher")
+    ap.add_argument("--hostfile", default=None)
+    ap.add_argument("--include", default="", help="host[@host...] to keep")
+    ap.add_argument("--exclude", default="", help="host[@host...] to drop")
+    ap.add_argument("--master_addr", default=None)
+    ap.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    ap.add_argument("--ssh_port", type=int, default=22)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    cmd = [sys.executable, args.script, *args.script_args]
+
+    if args.hostfile is None:
+        # single host: exec in place (reference launch.py single-node path)
+        os.execvpe(cmd[0], cmd, dict(os.environ))
+
+    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include,
+                         args.exclude)
+    names = list(hosts)
+    coord = f"{args.master_addr or names[0]}:{args.master_port}"
+    procs: List[subprocess.Popen] = []
+
+    def _kill(*_):
+        # reference sigkill_handler (runner.py:633): tear the tree down
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+
+    for idx, host in enumerate(names):
+        env_exports = " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in [
+                ("DSTPU_COORDINATOR", coord),
+                ("DSTPU_NUM_PROCESSES", str(len(names))),
+                ("DSTPU_PROCESS_ID", str(idx)),
+            ])
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
+            " ".join(shlex.quote(c) for c in cmd)
+        procs.append(subprocess.Popen(
+            ["ssh", "-p", str(args.ssh_port), host, remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

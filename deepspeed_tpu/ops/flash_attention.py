@@ -309,9 +309,18 @@ def _flash_bwd(causal, q_offset, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _supported(tq, tk, d, block_q, block_k) -> bool:
-    return (tq % block_q == 0 and tk % block_k == 0 and
-            tq >= block_q and tk >= block_k and d <= 256)
+#: per-tensor VMEM budget for the full-K/V-resident BlockSpecs (a core has
+#: ~16 MiB; K+V+Q/dO tiles must co-reside, so cap each at 4 MiB)
+_VMEM_PER_TENSOR = 4 * 1024 * 1024
+
+
+def _supported(tq, tk, d, block_q, block_k, itemsize=2) -> bool:
+    if not (tq % block_q == 0 and tk % block_k == 0 and
+            tq >= block_q and tk >= block_k and d <= 256):
+        return False
+    # the fwd/bwd kernels keep whole K/V (and Q/dO in the dkv kernel)
+    # resident per program — bound it or fall back to XLA
+    return max(tq, tk) * d * itemsize <= _VMEM_PER_TENSOR
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -332,7 +341,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret = jax.default_backend() != "tpu"
     bq = block_q or min(DEFAULT_BLOCK_Q, tq)
     bk = block_k or min(DEFAULT_BLOCK_K, tk)
-    if not _supported(tq, tk, d, bq, bk) or h % kvh:
+    if not _supported(tq, tk, d, bq, bk, q.dtype.itemsize) or h % kvh:
         from deepspeed_tpu.models.transformer import dot_product_attention
         return dot_product_attention(q, k, v, causal=causal,
                                      q_offset=q_offset)

@@ -1,0 +1,77 @@
+"""Python wrapper for the native host Adam (ZeRO-Offload optimizer).
+
+Reference: ``ops/adam/cpu_adam.py:13`` (DeepSpeedCPUAdam). Operates on
+flat fp32 numpy buffers (the host mirror of the reference's flat fp32
+partitions); falls back to a pure-numpy step when no C++ toolchain exists.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import is_native_available, load_host_adam
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class HostAdam:
+    """Fused Adam/AdamW over one flat fp32 parameter buffer."""
+
+    def __init__(self, num_elements: int, lr: float = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 use_native: Optional[bool] = None):
+        self.n = int(num_elements)
+        self.lr = lr
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self.exp_avg = np.zeros(self.n, np.float32)
+        self.exp_avg_sq = np.zeros(self.n, np.float32)
+        if use_native is None:
+            use_native = is_native_available()
+        self._lib = load_host_adam() if use_native else None
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        """In-place update of ``params`` (flat fp32, C-contiguous)."""
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        assert params.size == self.n == grads.size
+        self.step_count += 1
+        lr = self.lr if lr is None else float(lr)
+        if grads.dtype != np.float32:
+            grads = grads.astype(np.float32)
+        grads = np.ascontiguousarray(grads)
+        if self._lib is not None:
+            self._lib.ds_host_adam_step(
+                _f32p(params), _f32p(grads), _f32p(self.exp_avg),
+                _f32p(self.exp_avg_sq), self.n, self.step_count, lr,
+                self.beta1, self.beta2, self.eps, self.weight_decay,
+                1 if self.adamw_mode else 0)
+            return
+        # numpy fallback (identical math)
+        g = grads
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * params
+        self.exp_avg *= self.beta1
+        self.exp_avg += (1 - self.beta1) * g
+        self.exp_avg_sq *= self.beta2
+        self.exp_avg_sq += (1 - self.beta2) * g * g
+        bc1 = 1 - self.beta1 ** self.step_count
+        bc2 = 1 - self.beta2 ** self.step_count
+        update = (self.exp_avg / bc1) / (np.sqrt(self.exp_avg_sq / bc2)
+                                         + self.eps)
+        if self.adamw_mode and self.weight_decay:
+            update = update + self.weight_decay * params
+        params -= lr * update
+
+    def grad_norm(self, grads: np.ndarray) -> float:
+        if self._lib is not None and grads.dtype == np.float32 and \
+                grads.flags["C_CONTIGUOUS"]:
+            return float(np.sqrt(
+                self._lib.ds_l2_norm_sq(_f32p(grads), grads.size)))
+        return float(np.linalg.norm(grads.astype(np.float64)))

@@ -1,0 +1,94 @@
+"""FLOPs profiler.
+
+Reference: ``profiling/flops_profiler/profiler.py:30`` — the reference
+monkey-patches torch.nn.functional with counting wrappers. On TPU the
+compiler already knows: ``jax.jit(fn).lower(...).compile().cost_analysis()``
+returns XLA's own flop/byte counts for the exact compiled program,
+including fusion effects — strictly more accurate than op-level patching.
+"""
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
+    """Compile ``fn`` for the current devices and return XLA cost analysis:
+    {'flops': ..., 'bytes accessed': ..., 'optimal_seconds': ...} (keys as
+    XLA reports them, normalized a bit)."""
+    compiled = jax.jit(fn, static_argnums=static_argnums).lower(*args).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):          # per-device list on some backends
+        cost = cost[0] if cost else {}
+    out = {"flops": float(cost.get("flops", 0.0)),
+           "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+    try:
+        mem = compiled.memory_analysis()
+        out["peak_bytes"] = float(
+            getattr(mem, "temp_size_in_bytes", 0) +
+            getattr(mem, "argument_size_in_bytes", 0) +
+            getattr(mem, "output_size_in_bytes", 0))
+    except Exception:
+        pass
+    return out
+
+
+class FlopsProfiler:
+    """Step-granular profiler attached to an engine (reference
+    profiler.py API: start_profile/stop_profile/print_model_profile)."""
+
+    def __init__(self, engine=None, config=None):
+        self.engine = engine
+        self.config = config
+        self._t0: Optional[float] = None
+        self._steps = 0
+        self.flops_per_step: Optional[float] = None
+        self.last_tflops: Optional[float] = None
+
+    def start_profile(self) -> None:
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def step(self) -> None:
+        self._steps += 1
+
+    def stop_profile(self) -> Dict[str, float]:
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        result = {"seconds": dt, "steps": self._steps}
+        if self.engine is not None and self.engine.model.flops_per_token:
+            tokens = self._steps * int(self.engine.config.train_batch_size) \
+                * (self.engine.model.tokens_per_sample or 1)
+            flops = self.engine.model.flops_per_token * tokens
+            result["tflops"] = flops / max(dt, 1e-9) / 1e12
+            self.last_tflops = result["tflops"]
+        return result
+
+    def print_profile(self) -> None:
+        log_dist(f"flops profiler: {self.stop_profile()}")
+
+
+def get_model_profile(fn: Callable, args: Tuple,
+                      print_profile: bool = True) -> Tuple[float, float, int]:
+    """Reference get_model_profile API: returns (flops, macs, params).
+
+    'macs' ≈ flops/2 (XLA counts multiply-adds as 2 flops); params counted
+    from the first arg when it is a pytree of arrays.
+    """
+    cost = analyze_fn(fn, *args)
+    flops = cost["flops"]
+    params = 0
+    if args:
+        try:
+            params = sum(int(np.prod(x.shape))
+                         for x in jax.tree.leaves(args[0]))
+        except Exception:
+            params = 0
+    if print_profile:
+        log_dist(f"model profile: flops={flops:.3e} macs={flops / 2:.3e} "
+                 f"params={params / 1e6:.1f}M "
+                 f"bytes={cost.get('bytes_accessed', 0):.3e}")
+    return flops, flops / 2, params

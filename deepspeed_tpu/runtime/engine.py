@@ -107,6 +107,8 @@ class DeepSpeedTPUEngine:
         self.global_samples = 0
 
         # -- optimizer & schedule ------------------------------------------
+        self.offload_enabled = (
+            config.zero_optimization.offload_optimizer.device.value == "cpu")
         self.optimizer, base_lr = build_optimizer(
             config.optimizer.type, config.optimizer.params)
         self.lr_schedule: Schedule = build_schedule(
@@ -184,12 +186,24 @@ class DeepSpeedTPUEngine:
                 jax.tree.map(lambda x: x.astype(dtype)
                              if jnp.issubdtype(x.dtype, jnp.floating) and
                              dtype != jnp.float32 else x, params), param_sh)
+        self._param_shardings = param_sh
+        if self.offload_enabled:
+            # ZeRO-Offload: optimizer state lives in host DRAM
+            # (runtime/zero/offload.py); no device opt_state at all
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            self.host_optimizer = HostOffloadOptimizer(
+                self._abstract_params, self.config.optimizer.type,
+                self.config.optimizer.params, dtype)
+            self.host_optimizer.init_from(self.params)
+            self.opt_state = {}
+            self._state_shardings = {}
+            return
+        self.host_optimizer = None
         abstract_state = jax.eval_shape(self.optimizer.init, self.params)
         state_sh = self.plan.opt_state_shardings(abstract_state)
         self.opt_state = jax.jit(self.optimizer.init,
                                  out_shardings=state_sh)(self.params)
         self._state_shardings = state_sh
-        self._param_shardings = param_sh
 
     # ------------------------------------------------------------- jit build
 
@@ -252,8 +266,58 @@ class DeepSpeedTPUEngine:
                    "overflow": overflow.astype(jnp.int32)}
         return new_params, new_opt, scaler, metrics
 
+    def _accumulate_grads(self, params, batch, scale, rng):
+        """Shared GAS scan: stacked microbatches [gas, ...] → (fp32 grad
+        sum carrying the ZeRO grad shardings, per-micro losses)."""
+        def micro(carry, mb):
+            acc, r = carry
+            r, sub = jax.random.split(r)
+            loss, _m, grads = self._compute_loss_and_grads(
+                params, mb, scale, sub)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return (acc, r), loss
+
+        zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                            params)
+        zero = jax.lax.with_sharding_constraint(
+            zero, self.plan.grad_shardings())
+        (acc, _), losses = jax.lax.scan(micro, (zero, rng), batch)
+        return acc, losses
+
     def _build_step_functions(self) -> None:
         gas = int(self.config.gradient_accumulation_steps)
+
+        if self.offload_enabled:
+            if self.model.pipeline_loss_fn is not None:
+                raise ValueError(
+                    "pipeline parallelism with offload_optimizer.device="
+                    "'cpu' is not supported yet — the host step would "
+                    "bypass the pipeline schedule")
+            # grads computed on device, optimizer step on host (reference
+            # cpu_offload: stage_1_and_2.py:1332 + DeepSpeedCPUAdam)
+            def grads_only(params, batch, scale, rng):
+                acc, losses = self._accumulate_grads(params, batch, scale,
+                                                     rng)
+                acc = jax.tree.map(lambda g: g * (1.0 / gas), acc)
+                return acc, jnp.mean(losses)
+
+            self._offload_grad_step = jax.jit(grads_only)
+            self._fused_step = None
+
+            def single_grad(params, batch, scale, rng):
+                loss, _m, grads = self._compute_loss_and_grads(
+                    params, batch, scale, rng)
+                return loss, grads
+
+            self._grad_step = jax.jit(single_grad)
+            self._acc_add = jax.jit(
+                lambda acc, grads: jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads),
+                donate_argnums=(0,))
+            self._update_step = None
+            self._rng = jax.random.PRNGKey(self.config.seed + 1)
+            return
 
         if self.model.pipeline_loss_fn is not None:
             # pipeline path: the schedule consumes all M microbatches in
@@ -279,15 +343,6 @@ class DeepSpeedTPUEngine:
 
         # fused train_batch step: batch leaves have leading [gas, ...] dim
         def fused_step(params, opt_state, scaler, batch, step, rng):
-            def micro(carry, mb):
-                acc, r = carry
-                r, sub = jax.random.split(r)
-                loss, _m, grads = self._compute_loss_and_grads(
-                    params, mb, scaler.scale, sub)
-                acc = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                return (acc, r), loss
-
             if gas == 1:
                 mb = jax.tree.map(lambda x: x[0], batch)
                 rng, sub = jax.random.split(rng)
@@ -298,11 +353,8 @@ class DeepSpeedTPUEngine:
                 # accumulate in fp32 over microbatches (reference knob
                 # gradient_accumulation_dtype); the accumulator carries the
                 # grad shardings so ZeRO-2+ keeps it scattered across steps
-                zero = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-                zero = jax.lax.with_sharding_constraint(
-                    zero, self.plan.grad_shardings())
-                (acc, rng), losses = jax.lax.scan(micro, (zero, rng), batch)
+                acc, losses = self._accumulate_grads(params, batch,
+                                                     scaler.scale, rng)
             params, opt_state, scaler, metrics = self._apply_update(
                 params, opt_state, scaler, acc, step, gas)
             metrics["loss"] = jnp.mean(losses)
@@ -384,6 +436,15 @@ class DeepSpeedTPUEngine:
             return
         if self._acc_grads is None:
             raise RuntimeError("step() called with no accumulated gradients")
+        if self.offload_enabled:
+            grads = jax.tree.map(lambda g: g / gas, self._acc_grads)
+            metrics = self._host_step(grads)
+            self._acc_grads = None
+            self.global_steps += 1
+            self.global_samples += int(self.config.train_batch_size)
+            self._last_metrics = metrics
+            self._write_monitor(metrics)
+            return
         self.params, self.opt_state, self.loss_scale_state, metrics = \
             self._update_step(self.params, self.opt_state,
                               self.loss_scale_state, self._acc_grads,
@@ -407,6 +468,18 @@ class DeepSpeedTPUEngine:
         batch = self._place_stacked_batch(batch)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
+        if self.offload_enabled:
+            grads, loss = self._offload_grad_step(
+                self.params, batch, self.loss_scale_state.scale, sub)
+            metrics = self._host_step(grads)
+            metrics["loss"] = loss
+            self.global_steps += 1
+            self.micro_steps += gas
+            self.global_samples += int(self.config.train_batch_size)
+            self._last_metrics = metrics
+            self.tput_timer.stop()
+            self._write_monitor(metrics)
+            return loss
         self.params, self.opt_state, self.loss_scale_state, metrics = \
             self._fused_step(self.params, self.opt_state,
                              self.loss_scale_state, batch,
@@ -421,6 +494,31 @@ class DeepSpeedTPUEngine:
         self.tput_timer.stop()
         self._write_monitor(metrics)
         return loss
+
+    def _host_step(self, grads: Pytree) -> Dict[str, Any]:
+        """ZeRO-Offload update: native host Adam over the flat master."""
+        lr = float(jax.device_get(
+            self.lr_schedule(jnp.int32(self.global_steps))))
+        scale = float(jax.device_get(self.loss_scale_state.scale)) \
+            if self.fp16_enabled else 1.0
+        new_params, metrics = self.host_optimizer.step(
+            grads, lr, grad_clip=self.config.gradient_clipping,
+            loss_scale=scale)
+        if new_params is None:        # fp16 overflow: skip
+            self.skipped_steps += 1
+        else:
+            self.params = jax.device_put(new_params, self._param_shardings)
+        if self.fp16_enabled:
+            from deepspeed_tpu.runtime.loss_scaler import update_scale
+            self.loss_scale_state = update_scale(
+                self.loss_scale_state,
+                jnp.asarray(bool(metrics["overflow"])),
+                dynamic=self.dynamic_loss_scale,
+                scale_window=self.config.fp16.loss_scale_window,
+                min_scale=self.config.fp16.min_loss_scale,
+                delayed_shift=self.config.fp16.hysteresis,
+                consecutive_hysteresis=self.config.fp16.consecutive_hysteresis)
+        return dict(metrics)
 
     def _own_data_iterator(self):
         """Persistent epoch-advancing iterator over the engine dataloader
@@ -528,8 +626,12 @@ class DeepSpeedTPUEngine:
             "global_samples": self.global_samples,
             "optimizer": self.optimizer.hyperparams,
             "client_state": client_state or {},
+            "offload": self.offload_enabled,
         }
-        _save(save_dir, tag, state, meta, save_latest=save_latest)
+        root = _save(save_dir, tag, state, meta, save_latest=save_latest)
+        if self.offload_enabled:
+            np.savez(os.path.join(root, "host_optimizer.npz"),
+                     **self.host_optimizer.state_dict())
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
@@ -551,7 +653,15 @@ class DeepSpeedTPUEngine:
         if state is None:
             return None, {}
         self.params = state["params"]
-        if load_optimizer_states:
+        if load_optimizer_states and self.offload_enabled:
+            host_path = os.path.join(load_dir, tag, "host_optimizer.npz")
+            if os.path.exists(host_path):
+                self.host_optimizer.load_state_dict(dict(np.load(host_path)))
+            else:
+                # checkpoint from a non-offload run: rebuild master from
+                # the loaded params (universal reshape across offload modes)
+                self.host_optimizer.init_from(self.params)
+        elif load_optimizer_states:
             self.opt_state = state["opt_state"]
         ls = state["loss_scale"]
         self.loss_scale_state = LossScaleState(*jax.tree.leaves(ls)) \

@@ -1,0 +1,125 @@
+"""Aux subsystems: flops profiler, elasticity, curriculum, launcher,
+comms logger (reference: tests/unit/{profiling,elasticity,launcher}/)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+from deepspeed_tpu.launcher.runner import (build_launch_env, filter_hosts,
+                                           parse_hostfile)
+from deepspeed_tpu.profiling.flops_profiler import analyze_fn, get_model_profile
+from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+
+def test_flops_profiler_matmul():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 512), jnp.float32)
+    cost = analyze_fn(lambda x, y: x @ y, a, b)
+    # 2*M*N*K flops
+    assert cost["flops"] == pytest.approx(2 * 128 * 256 * 512, rel=0.01)
+
+
+def test_get_model_profile_params():
+    params = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+    flops, macs, n = get_model_profile(
+        lambda p, x: x @ p["w"] + p["b"], (params, jnp.ones((8, 64))),
+        print_profile=False)
+    assert n == 64 * 64 + 64
+    assert macs == pytest.approx(flops / 2)
+    assert flops >= 2 * 8 * 64 * 64
+
+
+def test_elastic_batch_solver():
+    best, valid, table = get_compatible_gpus([2, 4], 64, 1, 16)
+    assert best in table
+    for dp in valid:
+        # batch divisible into micro x dp for some micro
+        assert any(best % (mb * dp) == 0 for mb in [2, 4])
+    # reference semantics: prefers widest compatibility
+    assert len(table[best]) == max(len(v) for v in table.values())
+
+
+def test_compute_elastic_config():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 8}}
+    batch, valid, micro = compute_elastic_config(cfg, world_size=4)
+    assert batch % 4 == 0 and micro in (2, 4)
+    with pytest.raises(ValueError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_curriculum_linear():
+    s = CurriculumScheduler({
+        "curriculum_type": "fixed_linear",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 32
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10_000) == 64
+    # difficulty_step quantization
+    assert s.get_difficulty(51) % 8 == 0
+
+
+def test_curriculum_discrete_and_root():
+    d = CurriculumScheduler({
+        "curriculum_type": "fixed_discrete",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"difficulty": [8, 32, 64],
+                            "max_step": [10, 20]}})
+    assert d.get_difficulty(5) == 8
+    assert d.get_difficulty(15) == 32
+    assert d.get_difficulty(25) == 64
+    r = CurriculumScheduler({
+        "curriculum_type": "fixed_root",
+        "min_difficulty": 0, "max_difficulty": 100,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 1, "root_degree": 2}})
+    # sqrt schedule: at 25% of steps, 50% difficulty
+    assert r.get_difficulty(25) == 50
+
+
+def test_hostfile_parse_and_filter(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n# comment\n"
+                  "worker-2 slots=8\n")
+    hosts = parse_hostfile(str(hf))
+    assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+    kept = filter_hosts(hosts, include="worker-0@worker-2")
+    assert list(kept) == ["worker-0", "worker-2"]
+    kept = filter_hosts(hosts, exclude="worker-1")
+    assert "worker-1" not in kept
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, include="nope")
+    dup = tmp_path / "dup"
+    dup.write_text("h slots=1\nh slots=2\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(str(dup))
+
+
+def test_launch_env():
+    env = build_launch_env("10.0.0.1:29500", 4, 2, base_env={})
+    assert env == {"DSTPU_COORDINATOR": "10.0.0.1:29500",
+                   "DSTPU_NUM_PROCESSES": "4", "DSTPU_PROCESS_ID": "2"}
+
+
+def test_comms_logger_records(devices):
+    from deepspeed_tpu.comm.comms_logger import comms_logger
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    comms_logger.enabled = True
+    comms_logger.reset()
+    mesh = build_mesh(data=8)
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    def f(x):
+        return jax.shard_map(lambda v: comm.all_reduce(v, "data"),
+                             mesh=mesh, in_specs=P("data"), out_specs=P())(x)
+    f(jnp.arange(8, dtype=jnp.float32))
+    assert comms_logger.has_records("all_reduce")
+    comms_logger.enabled = False

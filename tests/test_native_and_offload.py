@@ -1,0 +1,144 @@
+"""Native C++ ops + ZeRO-Offload tests (reference: tests/unit/ops/adam/
+test_cpu_adam.py, tests/perf/adam_test.py, aio tests)."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import torch
+
+from deepspeed_tpu.ops.host_adam import HostAdam
+from deepspeed_tpu.ops.op_builder import is_native_available
+
+N = 50_000
+
+
+@pytest.mark.parametrize("use_native",
+                         [False] + ([True] if is_native_available() else []))
+@pytest.mark.parametrize("adamw", [True, False])
+def test_host_adam_matches_torch(use_native, adamw):
+    rng = np.random.default_rng(0)
+    params = rng.normal(size=N).astype(np.float32)
+    grads = rng.normal(size=N).astype(np.float32)
+
+    tp = torch.nn.Parameter(torch.tensor(params.copy()))
+    cls = torch.optim.AdamW if adamw else torch.optim.Adam
+    topt = cls([tp], lr=1e-3, weight_decay=0.01)
+
+    opt = HostAdam(N, lr=1e-3, weight_decay=0.01, adamw_mode=adamw,
+                   use_native=use_native)
+    ours = params.copy()
+    for _ in range(5):
+        tp.grad = torch.tensor(grads.copy())
+        topt.step()
+        opt.step(ours, grads)
+    np.testing.assert_allclose(ours, tp.detach().numpy(), rtol=3e-5,
+                               atol=3e-6)
+
+
+@pytest.mark.skipif(not is_native_available(), reason="no C++ toolchain")
+def test_native_bf16_roundtrip():
+    import ctypes
+    from deepspeed_tpu.ops.op_builder import load_host_adam
+    lib = load_host_adam()
+    x = np.random.default_rng(0).normal(size=1024).astype(np.float32)
+    bf = np.empty(1024, np.uint16)
+    back = np.empty(1024, np.float32)
+    lib.ds_f32_to_bf16(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       bf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                       1024)
+    lib.ds_bf16_to_f32(bf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                       back.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       1024)
+    ref = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
+    np.testing.assert_array_equal(back, ref)
+
+
+@pytest.mark.parametrize("use_native",
+                         [False] + ([True] if is_native_available() else []))
+def test_async_io_roundtrip(tmp_path, use_native):
+    from deepspeed_tpu.io.async_io import AsyncIOEngine
+    eng = AsyncIOEngine(num_threads=2, use_native=use_native)
+    data = [np.random.default_rng(i).normal(size=4096).astype(np.float32)
+            for i in range(4)]
+    paths = [str(tmp_path / f"swap_{i}.bin") for i in range(4)]
+    for p, d in zip(paths, data):
+        eng.pwrite(p, d)
+    assert eng.drain() == 0
+    out = [np.empty(4096, np.float32) for _ in range(4)]
+    for p, o in zip(paths, out):
+        eng.pread(p, o)
+    assert eng.drain() == 0
+    for d, o in zip(data, out):
+        np.testing.assert_array_equal(d, o)
+
+
+def test_zero_offload_training_matches_device(devices):
+    """offload_optimizer.device=cpu must track the on-device Adam run."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(0)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(4)]
+
+    def run(offload):
+        build_mesh(data=8)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {"device": "cpu" if offload else "none"},
+            },
+        }
+        eng, *_ = initialize(model=model, config=cfg,
+                             rng=jax.random.PRNGKey(5))
+        it = iter(batches)
+        losses = [float(eng.train_batch(it)) for _ in range(2)]
+        return losses, jax.device_get(eng.params["embed"]["tokens"])
+
+    l_dev, p_dev = run(False)
+    l_off, p_off = run(True)
+    np.testing.assert_allclose(l_off, l_dev, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p_off, p_dev, rtol=1e-4, atol=1e-5)
+
+
+def test_zero_offload_checkpoint_roundtrip(tmp_path, devices):
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    build_mesh(data=8)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    rng = np.random.default_rng(1)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(3)]
+    e1, *_ = initialize(model=model, config=cfg, rng=jax.random.PRNGKey(9))
+    e1.train_batch(iter(batches[:1]))
+    e1.save_checkpoint(str(tmp_path))
+    for b in batches[1:]:
+        e1.train_batch(iter([b]))
+    final = jax.device_get(e1.params["embed"]["tokens"])
+
+    e2, *_ = initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.host_optimizer.adam.step_count == 1
+    for b in batches[1:]:
+        e2.train_batch(iter([b]))
+    resumed = jax.device_get(e2.params["embed"]["tokens"])
+    np.testing.assert_allclose(final, resumed, rtol=1e-6, atol=1e-7)
