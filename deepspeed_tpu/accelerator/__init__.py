@@ -1,0 +1,7 @@
+"""L0 accelerator abstraction (reference: accelerator/ package)."""
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator  # noqa: F401
+from deepspeed_tpu.accelerator.real_accelerator import (get_accelerator,  # noqa: F401
+                                                        set_accelerator)
+from deepspeed_tpu.accelerator.tpu_accelerator import (CPU_Accelerator,  # noqa: F401
+                                                       TPU_Accelerator)

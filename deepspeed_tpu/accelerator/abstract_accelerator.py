@@ -1,0 +1,155 @@
+"""Accelerator abstraction — the L0 seam of the framework.
+
+TPU-native re-design of the reference's ``DeepSpeedAccelerator`` ABC
+(accelerator/abstract_accelerator.py:10, ~70 abstract methods). Large parts
+of that surface exist only because torch exposes mutable global device
+state (streams, events, per-device allocators, graph capture). Under
+jax/XLA those concepts are either functional (RNG = explicit PRNGKey),
+compiler-owned (streams/graphs ≈ jit), or queryable but not settable
+(devices are process-global). The ABC below keeps the reference's seams
+that still mean something on TPU:
+
+- device identity/count/sync            (reference :33–60)
+- RNG seeding → functional PRNGKey      (reference :62–89)
+- memory statistics                      (reference :114–165)
+- dtype capability probes                (reference :167–178)
+- pinned/host memory                     (reference :258–268)
+- op-builder dispatch                    (reference :274–286)
+- communication_backend_name             (reference :201–203)
+
+Dropped as N/A (documented, not stubbed): Stream/Event (XLA async dispatch
++ donation replace manual streams), graph capture/replay (jit), set_device
+(jax owns placement via shardings).
+"""
+
+import abc
+from typing import Any, Dict, Optional, Sequence
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str
+
+    # ------------------------------------------------------------ device API
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        """True if this accelerator's platform has at least one device."""
+
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        """'tpu' or 'tpu:3' style name (reference :33)."""
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        """The jax.Device object (reference returns a torch device ctx)."""
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Local (this-process) addressable device count."""
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        """All devices across the pod (multi-host)."""
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        """Index of the default device."""
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Drain outstanding async work on the device (reference :54)."""
+
+    # --------------------------------------------------------------- RNG API
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> None:
+        """Set the process seed; subsequent default_generator() keys derive
+        from it. Functional analogue of torch.manual_seed (reference :62)."""
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int: ...
+
+    @abc.abstractmethod
+    def default_generator(self, device_index: int = 0):
+        """A fresh jax PRNGKey folded in with the device index. Each call
+        advances the process stream (stateful seam over functional RNG)."""
+
+    # ------------------------------------------------------------ memory API
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]: ...
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get(
+            "peak_bytes_in_use", self.memory_allocated(device_index)))
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None: ...
+
+    # ------------------------------------------------------------- dtype API
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> Sequence[Any]: ...
+
+    # ----------------------------------------------------------- comm/builder
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        """'ici' on TPU (XLA collectives over ICI/DCN), 'host' on CPU —
+        the reference returns 'nccl'/'ccl'/'hccl' here (:201)."""
+
+    @abc.abstractmethod
+    def create_op_builder(self, class_name: str):
+        """Instantiate a NativeOpBuilder by op name (reference :274)."""
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name: str):
+        """Return the builder class/factory without instantiating."""
+
+    # ------------------------------------------------------------ host memory
+    @abc.abstractmethod
+    def pin_memory(self, array, align_bytes: int = 1):
+        """Return a host buffer suitable for async DMA. On TPU-VM, host
+        RAM is directly DMA-visible; numpy arrays need only alignment
+        (reference :258 pins CUDA host memory)."""
+
+    @abc.abstractmethod
+    def is_pinned(self, array) -> bool: ...
+
+    # -------------------------------------------------------------- utilities
+    def on_accelerator(self, array) -> bool:
+        """True if the jax array lives on this accelerator's platform."""
+        try:
+            shards = array.devices() if hasattr(array, "devices") else set()
+            return any(d.platform == self._name for d in shards)
+        except Exception:
+            return False
+
+    def range_push(self, msg: str) -> None:
+        """Profiler range marker (reference nvtx :221). Routed to
+        jax.profiler traces when active; cheap no-op otherwise."""
+        import jax.profiler as _p
+        tc = getattr(self, "_trace_ctxs", None)
+        if tc is None:
+            tc = self._trace_ctxs = []
+        ctx = _p.TraceAnnotation(msg)
+        ctx.__enter__()
+        tc.append(ctx)
+
+    def range_pop(self) -> None:
+        tc = getattr(self, "_trace_ctxs", None)
+        if tc:
+            tc.pop().__exit__(None, None, None)

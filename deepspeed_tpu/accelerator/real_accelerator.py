@@ -1,0 +1,38 @@
+"""Accelerator singleton resolution.
+
+Reference: accelerator/real_accelerator.py:51 (``get_accelerator`` honors
+the DS_ACCELERATOR env override, else probes vendor runtimes). Here the
+probe is jax's backend discovery; override with ``DSTPU_ACCELERATOR``
+('tpu' | 'cpu').
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import (CPU_Accelerator,
+                                                       TPU_Accelerator)
+from deepspeed_tpu.utils.logging import logger
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is not None:
+        return _ACCELERATOR
+    name = os.environ.get("DSTPU_ACCELERATOR")
+    if name is None:
+        import jax
+        name = "tpu" if jax.default_backend() == "tpu" else "cpu"
+    if name not in ("tpu", "cpu"):
+        raise ValueError(
+            f"DSTPU_ACCELERATOR={name!r} invalid; expected 'tpu' or 'cpu'")
+    _ACCELERATOR = TPU_Accelerator() if name == "tpu" else CPU_Accelerator()
+    logger.info(f"accelerator: {name}")
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel

@@ -1,0 +1,170 @@
+"""Concrete accelerators: TPU (jax/XLA) and CPU (virtual-device testing).
+
+Reference analogue: accelerator/cuda_accelerator.py (387 LoC) and
+cpu_accelerator.py. One implementation serves both platforms here because
+jax abstracts the device API; only capability probes and the comm backend
+name differ.
+"""
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+#: buffers registered by pin_memory (ndarrays accept neither attributes nor
+#: hashing, so membership is id-keyed out-of-band; weakref callbacks clear
+#: entries so pinning never leaks)
+import weakref
+_PINNED: Dict[int, "weakref.ref"] = {}
+
+
+def _pin(arr: np.ndarray) -> None:
+    key = id(arr)
+    _PINNED[key] = weakref.ref(arr, lambda _r, k=key: _PINNED.pop(k, None))
+
+#: op name → (sources) registry for create_op_builder; mirrors the
+#: reference's one-builder-file-per-op layout (op_builder/__init__.py)
+_NATIVE_OPS = {
+    "host_adam": ["host_adam.cpp"],
+    "async_io": ["async_io.cpp"],
+}
+
+
+class _JaxAccelerator(DeepSpeedAccelerator):
+    """Shared jax-backed implementation."""
+
+    def __init__(self, platform: str):
+        self._name = platform
+        self._seed = 42
+
+    # ------------------------------------------------------------ device API
+    def is_available(self) -> bool:
+        try:
+            return len(jax.devices(self._name)) > 0
+        except RuntimeError:
+            return False
+
+    def _devices(self):
+        return jax.local_devices(backend=self._name)
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def device_count(self) -> int:
+        return len(self._devices())
+
+    def global_device_count(self) -> int:
+        return jax.device_count(backend=self._name)
+
+    def current_device(self) -> int:
+        return 0
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        # block on a token put to the device — drains its async queue
+        tok = jax.device_put(jnp.zeros((), jnp.int32),
+                             self.device(device_index))
+        jax.block_until_ready(tok)
+
+    # --------------------------------------------------------------- RNG API
+    def manual_seed(self, seed: int) -> None:
+        self._seed = int(seed)
+        self._stream = 0
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def default_generator(self, device_index: int = 0):
+        stream = getattr(self, "_stream", 0)
+        self._stream = stream + 1
+        key = jax.random.PRNGKey(self._seed)
+        return jax.random.fold_in(jax.random.fold_in(key, device_index),
+                                  stream)
+
+    # ------------------------------------------------------------ memory API
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        dev = self.device(device_index)
+        try:
+            return dict(dev.memory_stats() or {})
+        except Exception:
+            return {}
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        # XLA exposes peak stats read-only; track a high-water offset instead
+        stats = self.memory_stats(device_index)
+        self._peak_offset = stats.get("peak_bytes_in_use", 0)
+
+    # ------------------------------------------------------------- dtype API
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> Sequence[Any]:
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8,
+                jnp.float8_e4m3fn, jnp.float8_e5m2]
+
+    # ----------------------------------------------------------- comm/builder
+    def communication_backend_name(self) -> str:
+        return "ici" if self._name == "tpu" else "host"
+
+    def get_op_builder(self, class_name: str):
+        from deepspeed_tpu.ops.op_builder import NativeOpBuilder
+        if class_name not in _NATIVE_OPS:
+            raise KeyError(f"unknown native op '{class_name}'; "
+                           f"known: {sorted(_NATIVE_OPS)}")
+        sources = _NATIVE_OPS[class_name]
+        return lambda: NativeOpBuilder(class_name, sources=sources)
+
+    def create_op_builder(self, class_name: str):
+        return self.get_op_builder(class_name)()
+
+    # ------------------------------------------------------------ host memory
+    def pin_memory(self, array, align_bytes: int = 64):
+        """Return `array` backed by an align_bytes-aligned host buffer
+        (O_DIRECT NVMe I/O needs 512/4096-byte alignment)."""
+        arr = np.asarray(array)
+        if not (arr.ctypes.data % align_bytes == 0 and arr.flags.c_contiguous):
+            raw = np.empty(arr.nbytes + align_bytes, dtype=np.uint8)
+            off = (-raw.ctypes.data) % align_bytes
+            out = raw[off:off + arr.nbytes].view(arr.dtype).reshape(arr.shape)
+            out[...] = arr
+            arr = out
+        _pin(arr)
+        return arr
+
+    def is_pinned(self, array) -> bool:
+        ref = _PINNED.get(id(array))
+        return ref is not None and ref() is array
+
+
+class TPU_Accelerator(_JaxAccelerator):
+    def __init__(self):
+        super().__init__("tpu")
+
+
+class CPU_Accelerator(_JaxAccelerator):
+    def __init__(self):
+        super().__init__("cpu")
+
+    def memory_stats(self, device_index=None):
+        stats = super().memory_stats(device_index)
+        if not stats:
+            try:
+                import psutil
+                vm = psutil.virtual_memory()
+                stats = {"bytes_in_use": vm.used, "bytes_limit": vm.total,
+                         "peak_bytes_in_use": vm.used}
+            except Exception:
+                stats = {}
+        return stats

@@ -1,0 +1,108 @@
+"""Compressed (1-bit error-feedback) collectives.
+
+TPU-native re-design of the reference's compressed backends
+(runtime/comm/nccl.py:52 ``compressed_allreduce``, runtime/comm/
+compressed.py:58, cupy packbits in runtime/compression/cupy.py). The
+algorithm is the 1-bit Adam exchange: every worker sends only the SIGN of
+its (error-compensated) tensor plus one fp32 scale, a "server" stage
+averages and re-compresses with its own error feedback, and the result is
+broadcast back — 32× less traffic than an fp32 allreduce, with both error
+buffers guaranteeing the residual is re-injected next step.
+
+Mapping to TPU: the reference's torch.distributed all-to-all/allgather over
+packed cupy bits become ``lax.all_to_all``/``lax.all_gather`` over packed
+uint8 sign arrays inside ``shard_map``; XLA routes them over ICI/DCN. Bit
+packing is a reshape+dot on device (no cupy/CPU round-trip).
+
+All functions are shard_map/jit compatible (static shapes, no Python
+branches on traced values).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.comms_logger import comms_logger
+
+import numpy as _np
+#: numpy constant — a jnp array here would initialize the JAX backend at
+#: import time, pinning the platform before drivers can set XLA_FLAGS
+_POWERS = (2 ** _np.arange(8, dtype=_np.uint16)).astype(_np.uint8)
+
+
+def pack_signs(x: jax.Array) -> jax.Array:
+    """f32[n] (n % 8 == 0) → uint8[n/8]; bit k of byte j = sign(x[8j+k])>=0."""
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
+    return (bits * _POWERS).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array) -> jax.Array:
+    """uint8[m] → f32[8m] of ±1."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def _compress(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """x → (packed signs, scale, new error). scale = mean|x| preserves the
+    l1 norm (the reference's scale choice, nccl.py:92)."""
+    scale = jnp.mean(jnp.abs(x))
+    packed = pack_signs(x)
+    decompressed = scale * unpack_signs(packed)
+    return packed, scale, x - decompressed
+
+
+def compressed_allreduce(x: jax.Array,
+                         worker_error: jax.Array,
+                         server_error: jax.Array,
+                         axis_name: str) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """1-bit error-feedback allreduce (mean) along a mesh axis.
+
+    Must run inside shard_map. ``x`` is this worker's flat f32 tensor whose
+    length is divisible by 8 × axis size (pad upstream; see
+    :func:`padded_size`). ``worker_error``/``server_error`` have shapes
+    [n] and [n / world] respectively.
+
+    Returns (averaged tensor [n], new worker_error, new server_error).
+    """
+    world = lax.psum(1, axis_name)
+    n = x.shape[0]
+    comms_logger.append("compressed_allreduce", n // 8 + 4, axis_name)
+
+    # -- worker phase: compensate, compress, record residual --------------
+    compensated = x + worker_error
+    packed, scale, new_worker_error = _compress(compensated)
+
+    # -- exchange: chunk i of every worker lands on worker i --------------
+    # packed: [n/8] → [world, n/(8*world)]; all_to_all swaps the leading
+    # chunk axis for the worker axis (reference: dist.all_to_all_single)
+    chunks = packed.reshape(world, -1)
+    recv = lax.all_to_all(chunks, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True).reshape(world, -1)      # [world, n/8w]
+    scales = lax.all_gather(scale, axis_name)                 # [world]
+
+    # -- server phase: decompress, average, re-compress w/ server error --
+    signs = jax.vmap(unpack_signs)(recv)                      # [world, n/w]
+    avg = (scales[:, None] * signs).mean(axis=0)              # [n/world]
+    compensated_s = avg + server_error
+    packed_s, scale_s, new_server_error = _compress(compensated_s)
+
+    # -- broadcast: gather every server's compressed chunk ----------------
+    all_packed = lax.all_gather(packed_s, axis_name)              # [world, n/8w]
+    all_scales = lax.all_gather(scale_s, axis_name)               # [world]
+    out = (all_scales[:, None] *
+           jax.vmap(unpack_signs)(all_packed)).reshape(n)
+    return out, new_worker_error, new_server_error
+
+
+def padded_size(n: int, world: int) -> int:
+    """Smallest length ≥ n divisible by 8 × world (pack + chunk granularity)."""
+    q = 8 * world
+    return ((n + q - 1) // q) * q
+
+
+def init_error_buffers(n: int, world: int) -> Tuple[jax.Array, jax.Array]:
+    """Zero-initialized (worker_error, server_error) for a padded length n."""
+    assert n % (8 * world) == 0, f"{n} not divisible by 8*{world}"
+    return jnp.zeros((n,), jnp.float32), jnp.zeros((n // world,), jnp.float32)

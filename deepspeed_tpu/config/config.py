@@ -82,7 +82,7 @@ class ActivationCheckpointingConfig(TPUConfigModel):
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    #: jax-native: remat policy name: 'none'|'full'|'dots_saveable'|
+    #: jax-native remat policy: 'none'|'full'|'save_attn_out'|'dots_saveable'|
     #: 'nothing_saveable'|'dots_with_no_batch_dims_saveable'
     policy: str = "none"
 
@@ -110,6 +110,11 @@ class OffloadOptimizerConfig(TPUConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0
+    #: ZenFlow-style stall-free step (reference runtime/zenflow/engine.py:14):
+    #: the host Adam for step t runs concurrently with the device fwd/bwd of
+    #: step t+1 (gradients one step stale). bf16/fp32 only — fp16 dynamic
+    #: loss scaling needs the synchronous overflow signal.
+    overlap: bool = False
 
 
 class OffloadParamConfig(TPUConfigModel):
@@ -365,6 +370,13 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+
+    #: attention implementation (the reference's replace_with_kernel_inject
+    #: seam, inference/config.py): 'auto' picks the chunked-XLA path —
+    #: robust on every TPU runtime; 'pallas_flash' opts into the Pallas
+    #: kernel (fastest where Mosaic runs at full MXU rate); 'naive'
+    #: materializes [T,T] scores (tests/short seqs only)
+    attention_impl: str = "auto"
 
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False

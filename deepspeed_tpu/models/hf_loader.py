@@ -1,0 +1,242 @@
+"""HuggingFace checkpoint interop (safetensors ↔ transformer pytree).
+
+TPU-native equivalent of the reference's checkpoint engines + injection
+policies (inference/v2/checkpoint/huggingface_engine.py streaming loader,
+module_inject/auto_tp.py:193 layer-name policy walk). Instead of mutating
+torch modules layer-by-layer, we map HF tensor names into the functional
+pytree layout (layers stacked on a leading [L] axis for ``lax.scan``) and
+let `transformer.partition_specs` supply the TP/FSDP sharding rules — the
+AutoTP analogue is rule-driven sharding of the loaded pytree, applied by
+the engine via `jax.device_put` at initialize().
+
+Supported families: Llama/Mistral (silu_glu, RMSNorm, rope), Mixtral
+(MoE experts w1/w2/w3), Qwen2 (adds qkv biases). HF stores Linear weights
+as [out, in]; our einsum layout is [in, out], hence the transposes.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import DecoderConfig
+from deepspeed_tpu.utils.logging import logger
+
+Params = Any
+
+
+# ---------------------------------------------------------------------------
+# config mapping
+# ---------------------------------------------------------------------------
+
+_FAMILIES = ("llama", "mistral", "mixtral", "qwen2")
+
+
+def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
+    """HF config.json dict → DecoderConfig."""
+    mt = hf.get("model_type", "llama")
+    if mt not in _FAMILIES:
+        raise ValueError(f"unsupported model_type '{mt}'; "
+                         f"supported: {_FAMILIES}")
+    kw = dict(
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads",
+                            hf["num_attention_heads"]),
+        intermediate_size=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf.get("max_position_embeddings", 4096),
+        norm="rmsnorm",
+        activation="silu_glu",
+        pos_emb="rope",
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        use_bias=(mt == "qwen2"),   # qwen2: qkv bias only; handled in map
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    if mt == "mixtral":
+        kw.update(num_experts=hf["num_local_experts"],
+                  num_experts_per_tok=hf.get("num_experts_per_tok", 2))
+    return DecoderConfig(**kw)
+
+
+def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
+    hf = {
+        "model_type": "mixtral" if cfg.num_experts else "llama",
+        "architectures": ["MixtralForCausalLM" if cfg.num_experts
+                          else "LlamaForCausalLM"],
+        "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.kv_heads,
+        "intermediate_size": cfg.ffn_size,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": "float32",
+    }
+    if cfg.num_experts:
+        hf["num_local_experts"] = cfg.num_experts
+        hf["num_experts_per_tok"] = cfg.num_experts_per_tok
+    return hf
+
+
+# ---------------------------------------------------------------------------
+# tensor-name mapping
+# ---------------------------------------------------------------------------
+
+def _reader(model_dir: str):
+    """Yield a get(name)->np.ndarray over all safetensors shards (streamed:
+    tensors load lazily, one at a time — the 70B-scale requirement of the
+    reference's HuggingFaceCheckpointEngine)."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as fh:
+            weight_map = json.load(fh)["weight_map"]
+        handles: Dict[str, Any] = {}
+
+        def get(name: str) -> np.ndarray:
+            shard = weight_map[name]
+            if shard not in handles:
+                handles[shard] = safe_open(
+                    os.path.join(model_dir, shard), framework="np")
+            return handles[shard].get_tensor(name)
+
+        return get, set(weight_map)
+    single = os.path.join(model_dir, "model.safetensors")
+    handle = safe_open(single, framework="np")
+    names = set(handle.keys())
+    return handle.get_tensor, names
+
+
+def load_hf_checkpoint(model_dir: str, dtype=np.float32
+                       ) -> Tuple[DecoderConfig, Params]:
+    """Load an HF Llama/Mistral/Mixtral/Qwen2 checkpoint directory into
+    (DecoderConfig, params pytree)."""
+    with open(os.path.join(model_dir, "config.json")) as fh:
+        hf_cfg = json.load(fh)
+    cfg = config_from_hf(hf_cfg)
+    get, names = _reader(model_dir)
+    L = cfg.num_layers
+
+    def T(name):
+        return np.ascontiguousarray(get(name).astype(dtype).T)
+
+    def stackT(fmt):
+        return np.stack([T(fmt.format(i)) for i in range(L)])
+
+    def stack(fmt):
+        return np.stack([get(fmt.format(i)).astype(dtype)
+                         for i in range(L)])
+
+    p = "model.layers.{}."
+    attn = {
+        "wq": stackT(p + "self_attn.q_proj.weight"),
+        "wk": stackT(p + "self_attn.k_proj.weight"),
+        "wv": stackT(p + "self_attn.v_proj.weight"),
+        "wo": stackT(p + "self_attn.o_proj.weight"),
+    }
+    if p.format(0) + "self_attn.q_proj.bias" in names:   # qwen2
+        attn["bq"] = stack(p + "self_attn.q_proj.bias")
+        attn["bk"] = stack(p + "self_attn.k_proj.bias")
+        attn["bv"] = stack(p + "self_attn.v_proj.bias")
+        attn["bo"] = np.zeros((L, cfg.hidden_size), dtype)
+
+    layers: Dict[str, Any] = {
+        "attn": attn,
+        "ln1": {"scale": stack(p + "input_layernorm.weight")},
+        "ln2": {"scale": stack(p + "post_attention_layernorm.weight")},
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        ep = p + "block_sparse_moe.experts.{}."
+
+        def estackT(suffix):
+            return np.stack([
+                np.stack([T(ep.format(i, e) + suffix) for e in range(E)])
+                for i in range(L)])
+        layers["moe"] = {
+            "router": stackT(p + "block_sparse_moe.gate.weight"),
+            "wg": estackT("w1.weight"),       # mixtral w1 = gate
+            "wo": estackT("w2.weight"),       # w2 = down
+            "wi": estackT("w3.weight"),       # w3 = up
+        }
+    else:
+        layers["mlp"] = {
+            "wg": stackT(p + "mlp.gate_proj.weight"),
+            "wi": stackT(p + "mlp.up_proj.weight"),
+            "wo": stackT(p + "mlp.down_proj.weight"),
+        }
+
+    params: Params = {
+        "embed": {"tokens": get("model.embed_tokens.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {"scale": get("model.norm.weight").astype(dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = T("lm_head.weight")
+    logger.info(f"loaded HF checkpoint from {model_dir}: "
+                f"{cfg.num_params() / 1e6:.1f}M params, "
+                f"{hf_cfg.get('model_type')}")
+    return cfg, params
+
+
+def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
+                         out_dir: str) -> None:
+    """Write the pytree back as an HF-layout safetensors checkpoint
+    (single shard) + config.json — the reverse mapping, so models trained
+    here load in transformers."""
+    import jax
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x), np.float32), params)
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": host["embed"]["tokens"],
+        "model.norm.weight": host["final_norm"]["scale"],
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.ascontiguousarray(host["lm_head"].T)
+    lyr = host["layers"]
+    p = "model.layers.{}."
+    for i in range(cfg.num_layers):
+        a = lyr["attn"]
+        out[p.format(i) + "self_attn.q_proj.weight"] = \
+            np.ascontiguousarray(a["wq"][i].T)
+        out[p.format(i) + "self_attn.k_proj.weight"] = \
+            np.ascontiguousarray(a["wk"][i].T)
+        out[p.format(i) + "self_attn.v_proj.weight"] = \
+            np.ascontiguousarray(a["wv"][i].T)
+        out[p.format(i) + "self_attn.o_proj.weight"] = \
+            np.ascontiguousarray(a["wo"][i].T)
+        out[p.format(i) + "input_layernorm.weight"] = lyr["ln1"]["scale"][i]
+        out[p.format(i) + "post_attention_layernorm.weight"] = \
+            lyr["ln2"]["scale"][i]
+        if cfg.num_experts:
+            moe = lyr["moe"]
+            out[p.format(i) + "block_sparse_moe.gate.weight"] = \
+                np.ascontiguousarray(moe["router"][i].T)
+            for e in range(cfg.num_experts):
+                ep = p.format(i) + f"block_sparse_moe.experts.{e}."
+                out[ep + "w1.weight"] = np.ascontiguousarray(moe["wg"][i, e].T)
+                out[ep + "w2.weight"] = np.ascontiguousarray(moe["wo"][i, e].T)
+                out[ep + "w3.weight"] = np.ascontiguousarray(moe["wi"][i, e].T)
+        else:
+            m = lyr["mlp"]
+            out[p.format(i) + "mlp.gate_proj.weight"] = \
+                np.ascontiguousarray(m["wg"][i].T)
+            out[p.format(i) + "mlp.up_proj.weight"] = \
+                np.ascontiguousarray(m["wi"][i].T)
+            out[p.format(i) + "mlp.down_proj.weight"] = \
+                np.ascontiguousarray(m["wo"][i].T)
+    save_file(out, os.path.join(out_dir, "model.safetensors"),
+              metadata={"format": "pt"})
+    with open(os.path.join(out_dir, "config.json"), "w") as fh:
+        json.dump(config_to_hf(cfg), fh, indent=2)

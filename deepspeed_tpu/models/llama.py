@@ -10,7 +10,9 @@ def llama3_config(size: str = "8b", **overrides) -> DecoderConfig:
                      intermediate_size=128, vocab_size=512, max_seq_len=256),
         "350m": dict(hidden_size=1024, num_layers=24, num_heads=16,
                      num_kv_heads=8, intermediate_size=4096),
-        "1b":  dict(hidden_size=2048, num_layers=16, num_heads=32,
+        # TPU-native head sizing: dh=128 (one VREG lane tile) — halves the
+        # attention score traffic vs dh=64 at identical FLOPs/params
+        "1b":  dict(hidden_size=2048, num_layers=16, num_heads=16,
                     num_kv_heads=8, intermediate_size=8192),
         "8b":  dict(hidden_size=4096, num_layers=32, num_heads=32,
                     num_kv_heads=8, intermediate_size=14336),

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -176,6 +177,12 @@ def resolve_remat_policy(name: Optional[str]):
         "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
         "dots_with_no_batch_dims_saveable":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # save each block's attention output (64MB/layer at 8x2048x2048
+        # bf16); backward recomputes the cheap-to-recompute MLP/projection
+        # GEMMs but NOT attention — the best memory/time trade when
+        # attention is bandwidth-bound
+        "save_attn_out":
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
     }
     if name is not None and name not in policies:
         raise ValueError(f"unknown remat policy '{name}'; "
@@ -246,8 +253,10 @@ def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
                   ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hidden, aux_loss) — aux is 0 for dense blocks, the scaled
     load-balance loss for MoE blocks (reference sharded_moe.py l_aux)."""
-    h = x + _attention_block(cfg, p["attn"], _norm(cfg, p["ln1"], x),
-                             sin, cos, attn_fn)
+    attn_out = _attention_block(cfg, p["attn"], _norm(cfg, p["ln1"], x),
+                                sin, cos, attn_fn)
+    attn_out = checkpoint_name(attn_out, "attn_out")
+    h = x + attn_out
     normed = _norm(cfg, p["ln2"], h)
     if cfg.num_experts and moe_fn is not None:
         ff, aux = moe_fn(cfg, p["moe"], normed)

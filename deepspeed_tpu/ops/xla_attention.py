@@ -1,0 +1,94 @@
+"""Memory-efficient attention composed from XLA ops (no Pallas).
+
+Flash-style chunked attention: query chunks are processed one at a time
+against only the causally-visible key prefix, so the full [T, T] score
+matrix never materializes in HBM — yet every op is a plain einsum XLA can
+tile onto the MXU at full bf16 rate. ``jax.checkpoint`` per chunk keeps
+backward memory at one chunk's scores.
+
+Why this exists alongside ops/flash_attention.py (the Pallas kernel): on
+some TPU runtimes (notably remote/chipless compile paths) Mosaic kernels
+execute far below MXU rate while XLA einsums run at full speed; the engine
+picks the implementation via config (model_factory.select_attention,
+``tensor_parallel``-agnostic). Reference analogue: the v1 kernel-injection
+attention vs the default torch path (deepspeed/ops/transformer/inference/
+ds_attention.py) — same "fast kernel with a safe fallback" seam.
+"""
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
+                q_start: int, causal: bool, scale: float) -> jax.Array:
+    """One query chunk vs a key prefix.
+
+    qg: [B, Cq, KV, G, Dh], k/v: [B, Tk, KV, Dh] → [B, Cq, KV, G, Dh].
+    """
+    b, cq, kvh, g, dh = qg.shape
+    tk = k.shape[1]
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_start + jnp.arange(cq)
+        kpos = jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bkgts,bskd->btkgd", probs, v)
+
+
+def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = True,
+                      q_offset: int = 0,
+                      chunk_q: int = 256) -> jax.Array:
+    """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
+
+    The q-chunk loop is unrolled at trace time so each chunk attends to a
+    STATIC causal key prefix — the causal lower triangle is genuinely
+    skipped (half the FLOPs), not masked away. Each chunk is wrapped in
+    ``jax.checkpoint``: backward recomputes that chunk's scores instead of
+    saving [B, H, Tq, Tk] probabilities.
+    """
+    b, tq, h, dh = q.shape
+    _, tk, kvh, _ = k.shape
+    if tq <= chunk_q:
+        return dot_product_attention_ref(q, k, v, causal, q_offset)
+    g = h // kvh
+    scale = 1.0 / math.sqrt(dh)
+    qg = q.reshape(b, tq, kvh, g, dh)
+
+    chunk_fn = jax.checkpoint(
+        partial(_chunk_attn, causal=causal, scale=scale),
+        static_argnums=(3,))
+
+    # full chunks plus a static remainder chunk for non-multiple lengths
+    bounds = list(range(0, tq, chunk_q)) + [tq]
+    outs = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        q_start = lo + q_offset
+        qc = jax.lax.slice_in_dim(qg, lo, hi, axis=1)
+        if causal:
+            # static causal prefix: keys up to this chunk's last row
+            k_end = min(tk, q_start + (hi - lo))
+            kc = jax.lax.slice_in_dim(k, 0, k_end, axis=1)
+            vc = jax.lax.slice_in_dim(v, 0, k_end, axis=1)
+        else:
+            kc, vc = k, v
+        outs.append(chunk_fn(qc, kc, vc, q_start))
+    return jnp.concatenate(outs, axis=1).reshape(b, tq, h, dh)
+
+
+def dot_product_attention_ref(q, k, v, causal=True, q_offset=0):
+    """Single-chunk fallback (same math, full prefix)."""
+    b, tq, h, dh = q.shape
+    kvh = k.shape[2]
+    qg = q.reshape(b, tq, kvh, h // kvh, dh)
+    out = _chunk_attn(qg, k, v, q_offset, causal, 1.0 / math.sqrt(dh))
+    return out.reshape(b, tq, h, dh)

@@ -129,6 +129,10 @@ def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
 
 
 def get_world_size() -> int:
+    """Devices in the active mesh (NOT jax.device_count(): a sub-mesh —
+    e.g. dryrun over devices[:n] — must report its own size)."""
+    if _CURRENT_MESH is not None:
+        return _CURRENT_MESH.size
     return jax.device_count()
 
 

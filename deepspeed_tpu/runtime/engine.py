@@ -109,6 +109,8 @@ class DeepSpeedTPUEngine:
         # -- optimizer & schedule ------------------------------------------
         self.offload_enabled = (
             config.zero_optimization.offload_optimizer.device.value == "cpu")
+        self.offload_overlap = False
+        self._host_future = None
         self.optimizer, base_lr = build_optimizer(
             config.optimizer.type, config.optimizer.params)
         self.lr_schedule: Schedule = build_schedule(
@@ -294,15 +296,34 @@ class DeepSpeedTPUEngine:
                     "pipeline parallelism with offload_optimizer.device="
                     "'cpu' is not supported yet — the host step would "
                     "bypass the pipeline schedule")
-            # grads computed on device, optimizer step on host (reference
-            # cpu_offload: stage_1_and_2.py:1332 + DeepSpeedCPUAdam)
+            self.offload_overlap = bool(
+                self.config.zero_optimization.offload_optimizer.overlap)
+            if self.offload_overlap and self.fp16_enabled:
+                raise ValueError(
+                    "offload_optimizer.overlap requires bf16/fp32 — fp16 "
+                    "dynamic loss scaling needs the synchronous overflow "
+                    "signal (ZenFlow has the same restriction)")
+            layout = self.host_optimizer.layout
+            # grads leave the device as ONE flat transfer-dtype array
+            # (reference copies bit16 grads to pinned host buffers on a side
+            # stream, stage_1_and_2.py:1332; here one D2H of the flat concat)
+            transfer_dtype = self.compute_dtype
+
             def grads_only(params, batch, scale, rng):
                 acc, losses = self._accumulate_grads(params, batch, scale,
                                                      rng)
                 acc = jax.tree.map(lambda g: g * (1.0 / gas), acc)
-                return acc, jnp.mean(losses)
+                return layout.flatten_device(acc, transfer_dtype), \
+                    jnp.mean(losses)
 
             self._offload_grad_step = jax.jit(grads_only)
+
+            # flat compute-dtype master → params pytree with shardings
+            self._offload_unflatten = jax.jit(
+                lambda flat: layout.unflatten_device(
+                    flat, [self.compute_dtype] * len(layout.shapes)),
+                out_shardings=self._param_shardings)
+            self._host_future = None
             self._fused_step = None
 
             def single_grad(params, batch, scale, rng):
@@ -469,15 +490,35 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
         if self.offload_enabled:
-            grads, loss = self._offload_grad_step(
+            # dispatch device fwd/bwd first (async); with overlap the host
+            # Adam for the PREVIOUS step runs while this executes
+            flat_g, loss = self._offload_grad_step(
                 self.params, batch, self.loss_scale_state.scale, sub)
-            metrics = self._host_step(grads)
+            lr = float(jax.device_get(
+                self.lr_schedule(jnp.int32(self.global_steps))))
+            scale = float(jax.device_get(self.loss_scale_state.scale)) \
+                if self.fp16_enabled else 1.0
+            if self.offload_overlap:
+                self._drain_host_step()          # apply step t-1's update
+                g_np = np.asarray(flat_g)        # blocks on device bwd
+                self._host_future = self.host_optimizer.step_flat_async(
+                    g_np, lr, grad_clip=self.config.gradient_clipping,
+                    loss_scale=scale,
+                    wait_on=getattr(self, "_last_upload", None))
+                metrics = dict(getattr(self, "_last_host_metrics", None) or
+                               {"grad_norm": 0.0, "overflow": 0, "lr": lr})
+            else:
+                g_np = np.asarray(flat_g)
+                metrics = self._apply_host_result(
+                    self.host_optimizer.step_flat(
+                        g_np, lr, grad_clip=self.config.gradient_clipping,
+                        loss_scale=scale))
             metrics["loss"] = loss
             self.global_steps += 1
             self.micro_steps += gas
             self.global_samples += int(self.config.train_batch_size)
             self._last_metrics = metrics
-            self.tput_timer.stop()
+            self.tput_timer.stop(sync=loss)
             self._write_monitor(metrics)
             return loss
         self.params, self.opt_state, self.loss_scale_state, metrics = \
@@ -491,23 +532,23 @@ class DeepSpeedTPUEngine:
             self.skipped_steps += 1
         self._last_metrics = metrics
         loss = metrics["loss"]
-        self.tput_timer.stop()
+        self.tput_timer.stop(sync=loss)
         self._write_monitor(metrics)
         return loss
 
-    def _host_step(self, grads: Pytree) -> Dict[str, Any]:
-        """ZeRO-Offload update: native host Adam over the flat master."""
-        lr = float(jax.device_get(
-            self.lr_schedule(jnp.int32(self.global_steps))))
-        scale = float(jax.device_get(self.loss_scale_state.scale)) \
-            if self.fp16_enabled else 1.0
-        new_params, metrics = self.host_optimizer.step(
-            grads, lr, grad_clip=self.config.gradient_clipping,
-            loss_scale=scale)
-        if new_params is None:        # fp16 overflow: skip
+    def _apply_host_result(self, result) -> Dict[str, Any]:
+        """Upload the host step's flat master (ONE device_put + jitted
+        unflatten) and fold in overflow/loss-scale bookkeeping."""
+        new_flat, metrics = result
+        if new_flat is None:          # fp16 overflow: skip
             self.skipped_steps += 1
         else:
-            self.params = jax.device_put(new_params, self._param_shardings)
+            # split transfer from compute: _last_upload tracks ONLY the H2D
+            # DMA of the host buffer, so the next host step can block on it
+            # (buffer-reuse hazard) without waiting on queued device work
+            flat_dev = jnp.asarray(new_flat)
+            self._last_upload = flat_dev
+            self.params = self._offload_unflatten(flat_dev)
         if self.fp16_enabled:
             from deepspeed_tpu.runtime.loss_scaler import update_scale
             self.loss_scale_state = update_scale(
@@ -518,7 +559,25 @@ class DeepSpeedTPUEngine:
                 min_scale=self.config.fp16.min_loss_scale,
                 delayed_shift=self.config.fp16.hysteresis,
                 consecutive_hysteresis=self.config.fp16.consecutive_hysteresis)
+        self._last_host_metrics = dict(metrics)
         return dict(metrics)
+
+    def _drain_host_step(self) -> None:
+        """Wait for an in-flight overlapped host step and apply it."""
+        if getattr(self, "_host_future", None) is not None:
+            fut, self._host_future = self._host_future, None
+            self._apply_host_result(fut.result())
+
+    def _host_step(self, grads: Pytree) -> Dict[str, Any]:
+        """ZeRO-Offload update from a grads pytree (3-call parity path)."""
+        lr = float(jax.device_get(
+            self.lr_schedule(jnp.int32(self.global_steps))))
+        scale = float(jax.device_get(self.loss_scale_state.scale)) \
+            if self.fp16_enabled else 1.0
+        flat_g = self.host_optimizer.layout.flatten_np(grads)
+        return self._apply_host_result(self.host_optimizer.step_flat(
+            flat_g, lr, grad_clip=self.config.gradient_clipping,
+            loss_scale=scale))
 
     def _own_data_iterator(self):
         """Persistent epoch-advancing iterator over the engine dataloader
@@ -613,6 +672,8 @@ class DeepSpeedTPUEngine:
         so any later mesh can reload (deepspeed/checkpoint ds_to_universal
         is unnecessary)."""
         from deepspeed_tpu.checkpoint.store import save_checkpoint as _save
+        if self.offload_enabled:
+            self._drain_host_step()   # overlapped update must land first
         tag = tag or f"global_step{self.global_steps}"
         state = {
             "params": self.params,
@@ -638,6 +699,8 @@ class DeepSpeedTPUEngine:
                         **_kw) -> Tuple[Optional[str], Dict[str, Any]]:
         """Reference engine.py:3273."""
         from deepspeed_tpu.checkpoint.store import load_checkpoint as _load
+        if self.offload_enabled:
+            self._drain_host_step()
         shardings = {
             "params": self._param_shardings,
             "opt_state": self._state_shardings,

@@ -7,6 +7,7 @@ implementation selected by the DeepSpeed config, and attach the sharding
 plan (partition_specs) for AutoTP + ZeRO-3.
 """
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -21,19 +22,26 @@ from deepspeed_tpu.models.transformer import (DecoderConfig,
 
 
 def select_attention(ds_cfg: DeepSpeedTPUConfig):
-    """Pick the attention implementation from the parallel-topology config
-    (reference: DistributedAttention wrapping sequence/layer.py:331).
+    """Pick the attention implementation from the config (reference: the
+    replace_with_kernel_inject seam + DistributedAttention wrapping,
+    sequence/layer.py:331).
 
-    The local attention is the Pallas flash kernel on TPU (reference's
-    kernel-injection attention, csrc/transformer/inference) — it transparently
-    falls back to the XLA path off-TPU or for unsupported shapes."""
+    ``attention_impl``: 'auto' → chunked-XLA flash-style attention (never
+    materializes [T,T]; every op is an einsum XLA tiles onto the MXU —
+    robust on all TPU runtimes); 'pallas_flash' → the Pallas kernel;
+    'naive' → reference dot-product (tests/short seqs)."""
     import jax as _jax
     on_tpu = _jax.default_backend() == "tpu"
     sp = ds_cfg.sequence_parallel
+    impl = ds_cfg.attention_impl
+    if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
+        raise ValueError(f"unknown attention_impl '{impl}'; expected "
+                         "'auto'|'pallas_flash'|'xla_chunked'|'naive'")
     if sp.size > 1 and sp.mode == "ring":
         from deepspeed_tpu.parallel.ring import ring_attention
         return partial(ring_attention, axis_name="seq")
-    if on_tpu:
+    if impl == "pallas_flash" or (impl == "auto" and on_tpu and
+                                  os.environ.get("DSTPU_PALLAS_ATTN")):
         # mesh-aware Pallas flash kernel; its shard_map head-sharding over
         # ('model','seq') IS the Ulysses all-to-all when sp > 1
         from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
@@ -41,7 +49,10 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig):
     if sp.size > 1:
         from deepspeed_tpu.parallel.ulysses import distributed_attention
         return partial(distributed_attention, axis_name="seq")
-    return dot_product_attention
+    if impl == "naive" or (impl == "auto" and not on_tpu):
+        return dot_product_attention
+    from deepspeed_tpu.ops.xla_attention import chunked_attention
+    return chunked_attention
 
 
 def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):

@@ -2,11 +2,23 @@
 
 Reference: ``runtime/zero/stage_1_and_2.py`` cpu_offload path (grads
 copied to pinned host buffers :1332, DeepSpeedCPUAdam step on the flat
-fp32 partition) and ``ops/adam/cpu_adam.py``. TPU version: the fp32
-master weights and Adam moments live in host DRAM as ONE flat numpy
-buffer (the reference's flat partition layout); each step the device
-grads are fetched, the native SIMD Adam sweeps the flat buffer, and the
-updated master is cast back to the compute dtype and device_put.
+fp32 partition), ``ops/adam/cpu_adam.py``, and the ZenFlow stall-free
+variant (runtime/zenflow/engine.py:14, zenflow_stage_1_and_2.py:47). TPU
+version: the fp32 master weights and Adam moments live in host DRAM as ONE
+flat numpy buffer (the reference's flat partition layout). Each step:
+
+1. the jitted grad step emits ONE flat transfer-dtype gradient array
+   (device-side concat — not a per-leaf Python fetch loop),
+2. a single D2H fetch hands it to the host worker thread, where the native
+   SIMD Adam sweeps the flat buffer (bf16 grads are widened in C++),
+3. the updated master is narrowed to the compute dtype in C++ and uploaded
+   as ONE flat device_put; a jitted unflatten restores the params pytree
+   with its shardings.
+
+With ``offload_optimizer.overlap`` (ZenFlow-lite) the host step for step t
+runs while the device computes step t+1's gradients — the device never
+stalls on the host; updates apply one step late (accuracy-neutral per the
+ZenFlow results, reference blog: removes >60% of step idle time).
 
 This trades step latency for HBM: the device holds only compute-dtype
 params + transient grads — the config that lets a 16G v5e train models
@@ -14,6 +26,8 @@ whose Adam state would need 3x more memory (reference claim: 13B on one
 V100-32G, docs/_pages/training.md:77).
 """
 
+import concurrent.futures
+import ctypes
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -21,13 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.ops.host_adam import HostAdam
+from deepspeed_tpu.ops.op_builder import is_native_available, load_host_adam
 from deepspeed_tpu.utils.logging import log_dist
 
 Pytree = Any
 
 
 class FlatLayout:
-    """Stable flatten/unflatten between a params pytree and one fp32 buf."""
+    """Stable flatten/unflatten between a params pytree and one flat buf."""
 
     def __init__(self, abstract_params: Pytree):
         leaves, self.treedef = jax.tree_util.tree_flatten(abstract_params)
@@ -37,6 +52,27 @@ class FlatLayout:
         self.total = int(self.offsets[-1])
         self.dtypes = [x.dtype for x in leaves]
 
+    # -- device-side (traceable) ------------------------------------------
+    def flatten_device(self, tree: Pytree, dtype=jnp.float32) -> jax.Array:
+        """Traceable: pytree → flat [total] of ``dtype`` (one array, so the
+        engine fetches grads with a single D2H copy)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        return jnp.concatenate(
+            [l.reshape(-1).astype(dtype) for l in leaves])
+
+    def unflatten_device(self, flat: jax.Array, dtypes=None) -> Pytree:
+        """Traceable: flat [total] → pytree (leaf dtypes default to the
+        layout's)."""
+        dtypes = dtypes or self.dtypes
+        leaves = []
+        for off, size, shape, dt in zip(self.offsets, self.sizes,
+                                        self.shapes, dtypes):
+            leaves.append(
+                jax.lax.dynamic_slice_in_dim(flat, int(off), size)
+                .reshape(shape).astype(dt))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    # -- host-side ---------------------------------------------------------
     def flatten_np(self, tree: Pytree) -> np.ndarray:
         leaves = self.treedef.flatten_up_to(tree)
         out = np.empty(self.total, np.float32)
@@ -55,8 +91,20 @@ class FlatLayout:
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u16p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
 class HostOffloadOptimizer:
-    """Engine-facing optimizer whose state lives in host DRAM."""
+    """Engine-facing optimizer whose state lives in host DRAM.
+
+    ``step_flat``/``step_flat_async`` consume the flat transfer-dtype
+    gradient array; ``step`` (pytree) remains for the 3-call parity API.
+    """
 
     def __init__(self, abstract_params: Pytree, opt_name: str,
                  opt_params: dict, compute_dtype):
@@ -78,6 +126,13 @@ class HostOffloadOptimizer:
         self.master: Optional[np.ndarray] = None
         self.hyperparams = {"name": f"host_{name}", "offload": "cpu",
                             "betas": betas}
+        self._lib = load_host_adam() if is_native_available() else None
+        # single worker: host steps are strictly ordered
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        # scratch buffers reused across steps (no per-step 100M-element allocs)
+        self._g32 = np.empty(self.layout.total, np.float32)
+        self._out16 = np.empty(self.layout.total, np.uint16) \
+            if compute_dtype == jnp.bfloat16 else None
         log_dist(f"ZeRO-Offload host optimizer: {self.layout.total / 1e6:.1f}M "
                  f"elements in host DRAM "
                  f"({self.layout.total * 12 / 2**30:.2f} GiB opt state)")
@@ -85,20 +140,76 @@ class HostOffloadOptimizer:
     def init_from(self, params: Pytree) -> None:
         self.master = self.layout.flatten_np(params)
 
-    def step(self, grads: Pytree, lr: float, grad_clip: float = 0.0,
-             loss_scale: float = 1.0) -> Tuple[Pytree, dict]:
-        """Host step → (new device-dtype params pytree, metrics)."""
-        flat_g = self.layout.flatten_np(grads)
+    # ------------------------------------------------------------ flat path
+    def _widen_grads(self, flat_g: np.ndarray) -> np.ndarray:
+        """transfer-dtype grads → fp32 scratch (C++ widen for bf16)."""
+        if flat_g.dtype == np.float32:
+            np.copyto(self._g32, flat_g)
+        elif flat_g.dtype.name == "bfloat16":     # ml_dtypes; NOT fp16 —
+            # fp16 bits through the bf16 widener would be garbage
+            u16 = flat_g.view(np.uint16)
+            if self._lib is not None and u16.flags.c_contiguous:
+                self._lib.ds_bf16_to_f32(_u16p(u16), _f32p(self._g32),
+                                         self.layout.total)
+            else:
+                self._g32[:] = flat_g.astype(np.float32)
+        else:
+            self._g32[:] = flat_g.astype(np.float32)
+        return self._g32
+
+    def step_flat(self, flat_g: np.ndarray, lr: float,
+                  grad_clip: float = 0.0, loss_scale: float = 1.0,
+                  wait_on=None) -> Tuple[Optional[np.ndarray], dict]:
+        """Host step over the flat gradient → (flat compute-dtype params
+        or None on overflow, metrics). Runs on the caller's thread.
+
+        ``wait_on`` — a device array backed by the PREVIOUS step's output
+        buffer upload (engine passes the device_put result). Blocking on it
+        here guarantees the in-flight H2D DMA finished reading
+        ``self.master``/``self._out16`` before this step mutates them
+        (overlap mode's buffer-reuse hazard)."""
+        if wait_on is not None:
+            import jax as _jax
+            _jax.block_until_ready(wait_on)
+        g = self._widen_grads(np.asarray(flat_g))
         if loss_scale != 1.0:
-            flat_g *= 1.0 / loss_scale
-        overflow = not np.isfinite(flat_g).all()
-        norm = self.adam.grad_norm(flat_g)
+            g *= 1.0 / loss_scale
+        norm = self.adam.grad_norm(g)
+        overflow = not np.isfinite(norm)
         metrics = {"grad_norm": norm, "overflow": int(overflow), "lr": lr}
         if overflow:
             return None, metrics
         if grad_clip > 0 and norm > grad_clip:
-            flat_g *= grad_clip / (norm + 1e-6)
-        self.adam.step(self.master, flat_g, lr=lr)
+            g *= grad_clip / (norm + 1e-6)
+        self.adam.step(self.master, g, lr=lr)
+        return self._narrow_master(), metrics
+
+    def _narrow_master(self) -> np.ndarray:
+        """fp32 master → flat compute-dtype array for one device_put."""
+        if self._out16 is None:
+            return self.master
+        if self._lib is not None:
+            self._lib.ds_f32_to_bf16(_f32p(self.master), _u16p(self._out16),
+                                     self.layout.total)
+            import ml_dtypes
+            return self._out16.view(ml_dtypes.bfloat16)
+        return np.asarray(jnp.asarray(self.master).astype(jnp.bfloat16))
+
+    def step_flat_async(self, flat_g: np.ndarray, lr: float,
+                        grad_clip: float = 0.0, loss_scale: float = 1.0,
+                        wait_on=None) -> "concurrent.futures.Future":
+        """Submit the host step to the worker thread (ZenFlow overlap)."""
+        return self._pool.submit(self.step_flat, flat_g, lr, grad_clip,
+                                 loss_scale, wait_on)
+
+    # ---------------------------------------------------------- pytree path
+    def step(self, grads: Pytree, lr: float, grad_clip: float = 0.0,
+             loss_scale: float = 1.0) -> Tuple[Optional[Pytree], dict]:
+        """Pytree-in/pytree-out step (3-call parity API)."""
+        flat_g = self.layout.flatten_np(grads)
+        new_flat, metrics = self.step_flat(flat_g, lr, grad_clip, loss_scale)
+        if new_flat is None:
+            return None, metrics
         new_params = self.layout.unflatten(
             self.master, [self.compute_dtype] * len(self.layout.shapes))
         return new_params, metrics

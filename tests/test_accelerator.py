@@ -1,0 +1,77 @@
+"""L0 accelerator abstraction tests (reference: tests/unit/accelerator/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import (CPU_Accelerator, get_accelerator,
+                                       set_accelerator)
+
+
+def test_get_accelerator_singleton():
+    a = get_accelerator()
+    assert a is get_accelerator()
+    assert a.communication_backend_name() in ("ici", "host")
+
+
+def test_device_api(devices):
+    a = CPU_Accelerator()
+    assert a.is_available()
+    assert a.device_count() >= 8          # virtual 8-device CPU mesh
+    assert a.device_name() == "cpu"
+    assert a.device_name(3) == "cpu:3"
+    assert a.device(0) is jax.local_devices(backend="cpu")[0]
+    a.synchronize()                       # must not raise
+
+
+def test_rng_functional_seam():
+    a = CPU_Accelerator()
+    a.manual_seed(123)
+    assert a.initial_seed() == 123
+    k1 = a.default_generator(0)
+    k2 = a.default_generator(0)
+    # stream advances: consecutive keys differ
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    # deterministic restart
+    a.manual_seed(123)
+    k1b = a.default_generator(0)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k1b))
+
+
+def test_memory_stats():
+    a = CPU_Accelerator()
+    stats = a.memory_stats()
+    assert a.total_memory() >= 0
+    assert isinstance(stats, dict)
+
+
+def test_dtype_probes():
+    a = CPU_Accelerator()
+    assert a.is_bf16_supported()
+    assert jnp.bfloat16 in a.supported_dtypes()
+
+
+def test_pin_memory_alignment():
+    a = CPU_Accelerator()
+    x = np.arange(1000, dtype=np.float32)
+    p = a.pin_memory(x, align_bytes=512)
+    assert p.ctypes.data % 512 == 0
+    assert a.is_pinned(p)
+    np.testing.assert_array_equal(p, x)
+
+
+def test_op_builder_dispatch():
+    a = CPU_Accelerator()
+    b = a.create_op_builder("host_adam")
+    assert b.name == "host_adam"
+    try:
+        a.create_op_builder("nonexistent_op")
+        assert False, "expected KeyError"
+    except KeyError:
+        pass
+
+
+def test_on_accelerator(devices):
+    a = CPU_Accelerator()
+    x = jnp.ones((4,))
+    assert a.on_accelerator(x)

@@ -1,0 +1,146 @@
+"""Compressed (1-bit) collective tests.
+
+Reference analogue: tests/unit/comm/ + the onebit optimizer tests — here
+numeric properties of the error-feedback exchange on the virtual 8-device
+mesh, including exact parity with a numpy transcription of the two-stage
+(worker compress → server average+recompress) algorithm of
+runtime/comm/nccl.py:52.
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.compressed import (compressed_allreduce,
+                                           init_error_buffers, pack_signs,
+                                           padded_size, unpack_signs)
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+W = 8
+
+
+def _sharded_allreduce(mesh):
+    return jax.jit(shard_map(
+        partial(compressed_allreduce, axis_name="data"),
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data"))))
+
+
+def _numpy_reference(xs, we, se):
+    """Transcription of the two-stage 1-bit exchange (worker i serves
+    chunk i)."""
+    Wn, n = xs.shape
+    cs = n // Wn
+
+    def comp(x):
+        scale = np.abs(x).mean()
+        d = scale * np.where(x >= 0, 1.0, -1.0)
+        return d.astype(np.float32), (x - d).astype(np.float32)
+
+    d = np.zeros_like(xs)
+    nwe = np.zeros_like(we)
+    for w in range(Wn):
+        d[w], nwe[w] = comp(xs[w] + we[w])
+    avg = d.mean(axis=0)
+    out = np.zeros(n, np.float32)
+    nse = np.zeros_like(se)
+    for i in range(Wn):
+        sl = slice(i * cs, (i + 1) * cs)
+        out[sl], nse[i] = comp(avg[sl] + se[i])
+    return out, nwe, nse
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(128).astype(np.float32))
+    signs = unpack_signs(pack_signs(x))
+    np.testing.assert_array_equal(np.asarray(signs),
+                                  np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+def test_matches_numpy_reference(devices):
+    """One exchange step must equal the reference algorithm bit-for-bit
+    (modulo fp32 reduction order)."""
+    mesh = build_mesh(data=W)
+    n = padded_size(200, W)
+    rng = np.random.default_rng(2)
+    xs = rng.standard_normal((W, n)).astype(np.float32)
+    we = (rng.standard_normal((W, n)) * 0.1).astype(np.float32)
+    se = (rng.standard_normal((W, n // W)) * 0.1).astype(np.float32)
+
+    f = _sharded_allreduce(mesh)
+    out, nwe, nse = f(jnp.asarray(xs).reshape(-1),
+                      jnp.asarray(we).reshape(-1),
+                      jnp.asarray(se).reshape(-1))
+    out = np.asarray(out).reshape(W, n)
+    ref_out, ref_we, ref_se = _numpy_reference(xs, we, se)
+    for w in range(W):
+        np.testing.assert_allclose(out[w], ref_out, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nwe).reshape(W, n), ref_we,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nse).reshape(W, n // W), ref_se,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_exact_when_workers_identical_uniform(devices):
+    """Identical per-worker tensors with uniform |x| compress losslessly
+    through BOTH stages → result == x and zero residuals."""
+    mesh = build_mesh(data=W)
+    n = padded_size(64, W)
+    rng = np.random.default_rng(1)
+    x = (0.7 * rng.choice([-1.0, 1.0], size=n)).astype(np.float32)
+    xs = np.broadcast_to(x, (W, n)).copy()
+
+    f = _sharded_allreduce(mesh)
+    out, nwe, nse = f(jnp.asarray(xs).reshape(-1),
+                      jnp.zeros((W * n,), jnp.float32),
+                      jnp.zeros((n,), jnp.float32))
+    out = np.asarray(out).reshape(W, n)
+    for w in range(W):
+        np.testing.assert_allclose(out[w], x, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nwe), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nse), 0.0, atol=1e-6)
+
+
+def test_error_feedback_conservation(devices):
+    """(Σ_t out_t)/T = exact_mean - (mean_w we_T + se_T)/T: with bounded
+    residuals the time-average converges to the exact mean at rate 1/T."""
+    mesh = build_mesh(data=W)
+    n = padded_size(100, W)
+    rng = np.random.default_rng(2)
+    xs = jnp.asarray(rng.standard_normal((W, n)).astype(np.float32))
+    exact = np.asarray(xs).mean(axis=0)
+
+    f = _sharded_allreduce(mesh)
+    we = jnp.zeros((W * n,), jnp.float32)
+    se = jnp.zeros((n,), jnp.float32)
+    T = 50
+    total = np.zeros(n, np.float32)
+    first_err = None
+    for _ in range(T):
+        out, we, se = f(xs.reshape(-1), we, se)
+        o = np.asarray(out).reshape(W, n)[0]
+        if first_err is None:
+            first_err = np.abs(o - exact).mean()
+        total += o
+    we_np = np.asarray(we).reshape(W, n)
+    se_np = np.asarray(se)
+    # the identity itself (exact up to fp accumulation)
+    np.testing.assert_allclose(
+        total / T, exact - (we_np.mean(axis=0) + se_np) / T, atol=1e-3)
+    # error feedback: the time-average beats a single compressed step by a
+    # wide margin (measured ~8× at T=50; assert a conservative 3×). A few
+    # worker-error coordinates may drift on constant inputs — they cancel
+    # in the cross-worker mean, which is what the identity divides by T.
+    avg_err = np.abs(total / T - exact).mean()
+    assert avg_err < first_err / 3.0, (avg_err, first_err)
+
+
+def test_init_error_buffers():
+    we, se = init_error_buffers(64, 8)
+    assert we.shape == (64,) and se.shape == (8,)

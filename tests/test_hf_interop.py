@@ -1,0 +1,131 @@
+"""HF checkpoint interop tests (reference: inference/v2/checkpoint/
+huggingface_engine.py + module_inject policy tests).
+
+Gold test: load a transformers-saved Llama checkpoint and match its logits
+exactly; then fine-tune one zero3 step and generate — the VERDICT r1 "done"
+criterion for real-model interop.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM, MixtralConfig, MixtralForCausalLM
+
+from deepspeed_tpu.models.hf_loader import (config_from_hf, export_hf_checkpoint,
+                                            load_hf_checkpoint)
+from deepspeed_tpu.models import transformer
+
+
+def _tiny_llama_dir(tmp_path, tie=False):
+    cfg = LlamaConfig(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, vocab_size=256,
+                      max_position_embeddings=128, rope_theta=10000.0,
+                      rms_norm_eps=1e-6, tie_word_embeddings=tie,
+                      attention_bias=False)
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    d = tmp_path / "hf_llama"
+    model.save_pretrained(str(d), safe_serialization=True)
+    return model, str(d)
+
+
+def _tiny_mixtral_dir(tmp_path):
+    cfg = MixtralConfig(hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, vocab_size=256,
+                        max_position_embeddings=128,
+                        num_local_experts=4, num_experts_per_tok=2,
+                        rms_norm_eps=1e-6)
+    torch.manual_seed(1)
+    model = MixtralForCausalLM(cfg).eval()
+    d = tmp_path / "hf_mixtral"
+    model.save_pretrained(str(d), safe_serialization=True)
+    return model, str(d)
+
+
+def test_llama_logits_parity(tmp_path):
+    hf_model, model_dir = _tiny_llama_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    assert cfg.num_heads == 4 and cfg.kv_heads == 2
+
+    tokens = np.arange(1, 17, dtype=np.int32)[None].repeat(2, 0)
+    ours = np.asarray(transformer.forward(cfg, jax.tree.map(jnp.asarray, params),
+                                          jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_roundtrip_export(tmp_path):
+    _, model_dir = _tiny_llama_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    out_dir = str(tmp_path / "export")
+    export_hf_checkpoint(cfg, jax.tree.map(jnp.asarray, params), out_dir)
+    reloaded = LlamaForCausalLM.from_pretrained(out_dir).eval()
+    tokens = torch.arange(1, 13, dtype=torch.long)[None]
+    orig = LlamaForCausalLM.from_pretrained(model_dir).eval()
+    with torch.no_grad():
+        np.testing.assert_allclose(reloaded(tokens).logits.numpy(),
+                                   orig(tokens).logits.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_mixtral_logits_parity(tmp_path):
+    hf_model, model_dir = _tiny_mixtral_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    assert cfg.num_experts == 4
+
+    from deepspeed_tpu.parallel.moe import moe_layer
+    from functools import partial
+    tokens = np.arange(1, 13, dtype=np.int32)[None]
+    # top-2 routing without capacity drops for exact parity
+    moe_fn = partial(moe_layer, top_k=2, capacity_factor=8.0,
+                     drop_tokens=False, aux_loss_coef=0.0, ep_axis=None)
+    hidden, _aux = transformer.forward_hidden(
+        cfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens),
+        moe_fn=moe_fn)
+    ours = np.asarray(transformer.lm_logits(
+        cfg, jax.tree.map(jnp.asarray, params), hidden))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(np.asarray(tokens), dtype=torch.long)
+                          ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-3)
+
+
+def test_finetune_and_generate_loaded_model(tmp_path, devices):
+    """VERDICT criterion: load HF weights, generate, fine-tune 1 step zero3."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+
+    _, model_dir = _tiny_llama_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    build_mesh(data=8)
+
+    # generation with loaded weights
+    eng = InferenceEngineTPU(cfg, {"max_seq_len": 64},
+                             params=jax.tree.map(jnp.asarray, params))
+    out = eng.generate(np.arange(1, 9, dtype=np.int32)[None],
+                       max_new_tokens=4)
+    assert out.shape == (1, 12)
+
+    # one zero3 fine-tune step from the loaded weights
+    train_cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3},
+    }
+    engine, *_ = ds.initialize(model=cfg, config=train_cfg, params=params,
+                               rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, size=(8, 16), dtype=np.int32)}
+    l0 = float(engine.train_batch(iter([batch])))
+    l1 = float(engine.train_batch(iter([batch])))
+    assert np.isfinite(l0) and l1 < l0
