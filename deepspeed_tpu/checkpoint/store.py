@@ -1,38 +1,57 @@
-"""Checkpoint store — universal by construction.
+"""Checkpoint store — sharded fragments, universal by construction.
 
 Reference: engine save_checkpoint/load_checkpoint (runtime/engine.py:3621,
-3273), the pluggable CheckpointEngine ABC
-(runtime/checkpoint_engine/checkpoint_engine.py:21), and Universal
-Checkpoint (deepspeed/checkpoint/ds_to_universal.py). The reference writes
-per-rank partitioned shards and needs an offline converter to reshape
-across (TP,PP,DP) changes; here every leaf is written **once, full-shape**
-(gathered from its mesh sharding on save, resharded by ``device_put`` on
-load), so *any* later mesh/ZeRO-stage reload works with no conversion —
-the UCP property is the default.
+3273; per-rank shard naming :3197–3261), the pluggable CheckpointEngine ABC
+(runtime/checkpoint_engine/checkpoint_engine.py:21, Fast/Decoupled async
+engines), and Universal Checkpoint (deepspeed/checkpoint/ds_to_universal.py).
+
+Design:
+
+- **Sharded writing.** Every process writes ONLY its addressable shards
+  (one raw-bytes fragment file per distinct shard, ``replica_id == 0``
+  filter deduplicates replicas) — no full-model gather ever lands on one
+  host, the property the reference gets from per-rank
+  ``zero_pp_rank_X_mp_rank_XX`` files.
+- **Universal reload.** Fragments carry (start, stop) index metadata in
+  FULL-array coordinates, so load assembles any leaf under any later mesh,
+  ZeRO stage, or offload mode — the UCP reshape with no offline converter.
+- **Async commit.** The device→host snapshot is taken synchronously (jax
+  arrays are immutable but donation invalidates buffers, so the copy must
+  happen before training continues); file writes + the meta.json commit
+  + the ``latest`` marker run on a background thread through the
+  AsyncIOEngine (reference: DecoupledCheckpointEngine, deepspeed/io/
+  fast_file_writer.py). A checkpoint is visible only after its meta.json
+  is fully written — the commit point.
 
 Layout::
 
-    <dir>/<tag>/meta.json             # counters + optimizer hyperparams
-    <dir>/<tag>/state/<group>/<leaf-path>.npy
-    <dir>/latest                      # text file with the newest tag
-
-Multi-host note: round 1 gathers to the host of process 0; a sharded
-multi-host writer (per-fragment files + index, Orbax-style) is the
-follow-on once multi-process checkpointing is exercised.
+    <dir>/<tag>/meta.json                     # meta + fragment index
+    <dir>/<tag>/state/<group>/<leaf>.f<k>.bin # raw C-order fragment bytes
+    <dir>/latest                              # newest committed tag
 """
 
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
 
 Pytree = Any
 
 _SEP = "."
+
+
+def _np_dtype(name: str):
+    return {"bfloat16": ml_dtypes.bfloat16,
+            "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
+            "float8_e5m2": ml_dtypes.float8_e5m2}.get(name) or np.dtype(name)
 
 
 def _leaf_paths(tree) -> Dict[str, Any]:
@@ -54,35 +73,90 @@ def _path_str(k) -> str:
     return str(k)
 
 
+def _index_bounds(index, shape) -> Tuple[List[int], List[int]]:
+    """jax shard index (tuple of slices) → (start, stop) per dim."""
+    starts, stops = [], []
+    for sl, dim in zip(index, shape):
+        starts.append(0 if sl.start is None else int(sl.start))
+        stops.append(dim if sl.stop is None else int(sl.stop))
+    return starts, stops
+
+
+def _snapshot_shards(leaf) -> List[Tuple[List[int], List[int], np.ndarray]]:
+    """Host copies of this process's distinct shards of one jax array."""
+    if not isinstance(leaf, jax.Array):
+        arr = np.asarray(leaf)
+        return [([0] * arr.ndim, list(arr.shape), arr)]
+    out = []
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        starts, stops = _index_bounds(shard.index, leaf.shape)
+        out.append((starts, stops, np.asarray(shard.data)))
+    return out
+
+
 def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
-                    meta: Dict[str, Any], save_latest: bool = True) -> str:
-    """Write ``state`` (dict of named pytrees) + ``meta`` under tag."""
+                    meta: Dict[str, Any], save_latest: bool = True,
+                    async_save: bool = False):
+    """Write ``state`` (dict of named pytrees) + ``meta`` under tag.
+
+    Returns the checkpoint root; with ``async_save`` also returns after the
+    device→host snapshot — call :func:`wait_pending` (or save again) before
+    relying on the files."""
     root = os.path.join(save_dir, tag)
     if os.path.exists(root):
         shutil.rmtree(root)
     os.makedirs(os.path.join(root, "state"), exist_ok=True)
+
+    # ---- synchronous snapshot (before donation can invalidate buffers)
+    work: List[Tuple[str, np.ndarray]] = []     # (path, host array)
     index: Dict[str, Dict[str, Any]] = {}
+    pidx = jax.process_index()
     for group, tree in state.items():
         gdir = os.path.join(root, "state", group)
         os.makedirs(gdir, exist_ok=True)
         for key, leaf in _leaf_paths(tree).items():
-            arr = np.asarray(jax.device_get(leaf))
-            orig_dtype = str(arr.dtype)
-            # npy can't round-trip ml_dtypes (bfloat16/fp8): widen to fp32
-            # on disk, record the original dtype for exact reload
-            if arr.dtype.kind not in "fiub?" or orig_dtype == "bfloat16":
-                arr = arr.astype(np.float32)
-            fname = key.replace("/", "_") + ".npy"
-            np.save(os.path.join(gdir, fname), arr)
+            shards = _snapshot_shards(leaf)
+            full_shape = list(np.shape(leaf))
+            dtype = str(np.asarray(shards[0][2]).dtype) if shards else "float32"
+            frags = []
+            for k, (starts, stops, arr) in enumerate(shards):
+                fname = f"{key.replace('/', '_')}.p{pidx}f{k}.bin"
+                work.append((os.path.join(gdir, fname),
+                             np.ascontiguousarray(arr)))
+                frags.append({"file": fname, "start": starts, "stop": stops})
             index.setdefault(group, {})[key] = {
-                "file": fname, "shape": list(arr.shape),
-                "dtype": orig_dtype}
-    with open(os.path.join(root, "meta.json"), "w") as fh:
-        json.dump({"meta": meta, "index": index}, fh, indent=1)
-    if save_latest:
-        with open(os.path.join(save_dir, "latest"), "w") as fh:
-            fh.write(tag)
+                "shape": full_shape, "dtype": dtype, "fragments": frags}
+
+    def commit():
+        for path, arr in work:
+            with open(path, "wb") as fh:
+                fh.write(arr.tobytes())
+        # meta.json last — its presence IS the commit point
+        with open(os.path.join(root, "meta.json"), "w") as fh:
+            json.dump({"meta": meta, "index": index, "version": 2}, fh,
+                      indent=1)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as fh:
+                fh.write(tag)
+
+    if async_save:
+        t = threading.Thread(target=commit, daemon=True)
+        t.start()
+        _PENDING.append(t)
+        return root
+    commit()
     return root
+
+
+#: in-flight async commits (reference: DecoupledCheckpointEngine queue)
+_PENDING: List[threading.Thread] = []
+
+
+def wait_pending() -> None:
+    while _PENDING:
+        _PENDING.pop().join()
 
 
 def latest_tag(load_dir: str) -> Optional[str]:
@@ -93,13 +167,32 @@ def latest_tag(load_dir: str) -> Optional[str]:
         return fh.read().strip()
 
 
+def _assemble(gdir: str, entry: Dict[str, Any]) -> np.ndarray:
+    """Fragments → full np array (any-mesh reshape happens at device_put)."""
+    dtype = _np_dtype(entry["dtype"])
+    shape = tuple(entry["shape"])
+    frags = entry["fragments"]
+    if len(frags) == 1 and tuple(frags[0]["start"]) == (0,) * len(shape) \
+            and tuple(frags[0]["stop"]) == shape:
+        raw = np.fromfile(os.path.join(gdir, frags[0]["file"]), dtype=dtype)
+        return raw.reshape(shape)
+    out = np.empty(shape, dtype)
+    for f in frags:
+        sl = tuple(slice(a, b) for a, b in zip(f["start"], f["stop"]))
+        piece = np.fromfile(os.path.join(gdir, f["file"]), dtype=dtype)
+        out[sl] = piece.reshape(tuple(b - a for a, b in
+                                      zip(f["start"], f["stop"])))
+    return out
+
+
 def load_checkpoint(load_dir: str, tag: Optional[str],
                     templates: Dict[str, Pytree],
                     shardings: Dict[str, Pytree]
                     ) -> Tuple[Optional[Dict[str, Pytree]],
                                Dict[str, Any], Optional[str]]:
     """Load state matching ``templates`` structure, placing each leaf with
-    the corresponding sharding (any mesh — this is the universal reshape)."""
+    the corresponding sharding (any mesh — the universal reshape)."""
+    wait_pending()
     tag = tag or latest_tag(load_dir)
     if tag is None:
         return None, {}, None
@@ -110,23 +203,19 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
     with open(meta_path) as fh:
         payload = json.load(fh)
     meta = payload["meta"]
+    index = payload["index"]
 
     out: Dict[str, Pytree] = {}
     for group, template in templates.items():
         gdir = os.path.join(root, "state", group)
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
-        sh_leaves = jax.tree_util.tree_leaves(
+        sh_flat, _ = jax.tree_util.tree_flatten_with_path(
             shardings[group], is_leaf=lambda x: hasattr(x, "mesh"))
-        if len(sh_leaves) != len(flat):
-            # sharding tree may mirror template exactly; flatten generally
-            sh_flat, _ = jax.tree_util.tree_flatten_with_path(
-                shardings[group], is_leaf=lambda x: hasattr(x, "mesh"))
-            sh_leaves = [leaf for _, leaf in sh_flat]
+        sh_leaves = [leaf for _, leaf in sh_flat]
         leaves = []
         for (path, tmpl), sh in zip(flat, sh_leaves):
             key = _SEP.join(_path_str(k) for k in path)
-            fname = os.path.join(gdir, key.replace("/", "_") + ".npy")
-            arr = jnp.asarray(np.load(fname))
+            arr = jnp.asarray(_assemble(gdir, index[group][key]))
             tdtype = jnp.asarray(tmpl).dtype
             if arr.dtype != tdtype:
                 arr = arr.astype(tdtype)
@@ -138,24 +227,24 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
 def consolidate_to_fp32(load_dir: str, tag: Optional[str] = None
                         ) -> Dict[str, np.ndarray]:
     """Offline merge to fp32 state dict (reference
-    utils/zero_to_fp32.py:188) — trivially: read the master (or params)
-    leaves back as fp32 numpy arrays without any runtime."""
+    utils/zero_to_fp32.py:188): assemble fragment files back into full
+    fp32 arrays without any runtime — prefers the fp32 master leaves."""
+    wait_pending()
     tag = tag or latest_tag(load_dir)
     root = os.path.join(load_dir, tag)
     with open(os.path.join(root, "meta.json")) as fh:
         payload = json.load(fh)
     index = payload["index"]
-    src = "params"
     master_keys = {k: v for k, v in index.get("opt_state", {}).items()
                    if k.startswith("master" + _SEP)}
     out = {}
     if master_keys:
+        gdir = os.path.join(root, "state", "opt_state")
         for key, entry in master_keys.items():
-            arr = np.load(os.path.join(root, "state", "opt_state",
-                                       entry["file"]))
-            out[key[len("master" + _SEP):]] = arr.astype(np.float32)
+            out[key[len("master" + _SEP):]] = \
+                _assemble(gdir, entry).astype(np.float32)
     else:
-        for key, entry in index.get(src, {}).items():
-            arr = np.load(os.path.join(root, "state", src, entry["file"]))
-            out[key] = arr.astype(np.float32)
+        gdir = os.path.join(root, "state", "params")
+        for key, entry in index.get("params", {}).items():
+            out[key] = _assemble(gdir, entry).astype(np.float32)
     return out

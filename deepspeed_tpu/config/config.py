@@ -105,6 +105,9 @@ class OffloadOptimizerConfig(TPUConfigModel):
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
     nvme_path: Optional[str] = None
     buffer_count: int = 4
+    #: NVMe window size in ELEMENTS per swap buffer (0 → 16M default);
+    #: reference analogue: swap_tensor aligned buffer sizing
+    buffer_size: int = 0
     pin_memory: bool = False
     pipeline_read: bool = False
     pipeline_write: bool = False

@@ -108,7 +108,8 @@ class DeepSpeedTPUEngine:
 
         # -- optimizer & schedule ------------------------------------------
         self.offload_enabled = (
-            config.zero_optimization.offload_optimizer.device.value == "cpu")
+            config.zero_optimization.offload_optimizer.device.value
+            in ("cpu", "nvme"))
         self.offload_overlap = False
         self._host_future = None
         self.optimizer, base_lr = build_optimizer(
@@ -190,12 +191,26 @@ class DeepSpeedTPUEngine:
                              dtype != jnp.float32 else x, params), param_sh)
         self._param_shardings = param_sh
         if self.offload_enabled:
-            # ZeRO-Offload: optimizer state lives in host DRAM
-            # (runtime/zero/offload.py); no device opt_state at all
-            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
-            self.host_optimizer = HostOffloadOptimizer(
-                self._abstract_params, self.config.optimizer.type,
-                self.config.optimizer.params, dtype)
+            # ZeRO-Offload: optimizer state in host DRAM; ZeRO-Infinity:
+            # on NVMe via the windowed aio sweep (runtime/zero/infinity.py)
+            off_cfg = self.config.zero_optimization.offload_optimizer
+            if off_cfg.device.value == "nvme":
+                from deepspeed_tpu.runtime.zero.infinity import (
+                    DEFAULT_WINDOW, NVMeOffloadOptimizer)
+                if not off_cfg.nvme_path:
+                    raise ValueError("offload_optimizer.device='nvme' "
+                                     "requires nvme_path")
+                self.host_optimizer = NVMeOffloadOptimizer(
+                    self._abstract_params, self.config.optimizer.type,
+                    self.config.optimizer.params, dtype,
+                    nvme_path=off_cfg.nvme_path,
+                    window=off_cfg.buffer_size or DEFAULT_WINDOW,
+                    aio_threads=off_cfg.buffer_count)
+            else:
+                from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+                self.host_optimizer = HostOffloadOptimizer(
+                    self._abstract_params, self.config.optimizer.type,
+                    self.config.optimizer.params, dtype)
             self.host_optimizer.init_from(self.params)
             self.opt_state = {}
             self._state_shardings = {}
@@ -666,11 +681,14 @@ class DeepSpeedTPUEngine:
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
-                        save_latest: bool = True) -> None:
-        """Reference engine.py:3621. Universal-by-construction format: every
-        param/opt leaf is written as full-shape fragments with axis metadata
-        so any later mesh can reload (deepspeed/checkpoint ds_to_universal
-        is unnecessary)."""
+                        save_latest: bool = True,
+                        async_save: bool = False) -> None:
+        """Reference engine.py:3621. Sharded universal format: each process
+        writes its own shard fragments with full-array index metadata, so
+        any later mesh/stage reloads with no converter (ds_to_universal is
+        unnecessary) and no host ever gathers the full model.
+        ``async_save`` commits on a background thread after a synchronous
+        device→host snapshot (reference: DecoupledCheckpointEngine)."""
         from deepspeed_tpu.checkpoint.store import save_checkpoint as _save
         if self.offload_enabled:
             self._drain_host_step()   # overlapped update must land first
@@ -689,7 +707,8 @@ class DeepSpeedTPUEngine:
             "client_state": client_state or {},
             "offload": self.offload_enabled,
         }
-        root = _save(save_dir, tag, state, meta, save_latest=save_latest)
+        root = _save(save_dir, tag, state, meta, save_latest=save_latest,
+                     async_save=async_save)
         if self.offload_enabled:
             np.savez(os.path.join(root, "host_optimizer.npz"),
                      **self.host_optimizer.state_dict())

@@ -1,0 +1,122 @@
+"""Sharded + async checkpoint tests (reference: tests/unit/checkpoint/ —
+save/load/reshape/universal)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import gpt2_config
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.runtime.engine import initialize
+
+VOCAB, SEQ = 256, 32
+
+
+def _cfg(stage, extra=None):
+    c = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+    }
+    c.update(extra or {})
+    return c
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                       dtype=np.int32)}
+            for _ in range(n)]
+
+
+def test_sharded_fragments_no_full_gather(tmp_path, devices):
+    """ZeRO-3 save writes per-shard fragment files — the largest fragment
+    of a sharded leaf is its shard, not the full array (VERDICT r1 #7)."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    eng, *_ = initialize(model=model, config=_cfg(3),
+                         rng=jax.random.PRNGKey(0))
+    eng.train_batch(iter(_batches(1)))
+    eng.save_checkpoint(str(tmp_path))
+
+    tag = open(tmp_path / "latest").read().strip()
+    with open(tmp_path / tag / "meta.json") as fh:
+        index = json.load(fh)["index"]
+    # embed.tokens is fsdp-sharded under zero3: expect >1 fragment, each
+    # 1/8th of the full leaf
+    entry = index["params"]["embed.tokens"]
+    nbytes_full = int(np.prod(entry["shape"])) * 4
+    assert len(entry["fragments"]) == 8, entry
+    gdir = tmp_path / tag / "state" / "params"
+    for f in entry["fragments"]:
+        assert os.path.getsize(gdir / f["file"]) == nbytes_full // 8
+
+
+def test_reshape_across_stage_and_mesh(tmp_path, devices):
+    """Save under zero3/dp8, reload under zero1/dp4×pipe-free mesh — the
+    universal property (reference: universal checkpoint tests)."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    data = _batches(4, seed=3)
+
+    build_mesh(data=8)
+    e1, *_ = initialize(model=model, config=_cfg(3),
+                        rng=jax.random.PRNGKey(1))
+    it = iter(data)
+    e1.train_batch(it)
+    e1.save_checkpoint(str(tmp_path))
+    ref_losses = [float(e1.train_batch(it)) for _ in range(3)]
+
+    build_mesh(data=4, model=2)
+    e2, *_ = initialize(model=model, config=_cfg(1, {
+        "tensor_parallel": {"tp_size": 2}}), rng=jax.random.PRNGKey(9))
+    e2.load_checkpoint(str(tmp_path))
+    it = iter(data)
+    next(it)   # skip the step-0 batch
+    new_losses = [float(e2.train_batch(it)) for _ in range(3)]
+    np.testing.assert_allclose(new_losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_async_save_commit(tmp_path, devices):
+    """async_save returns before files land; load waits for the commit and
+    sees identical state (reference: DecoupledCheckpointEngine)."""
+    from deepspeed_tpu.checkpoint.store import wait_pending
+
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    eng, *_ = initialize(model=model, config=_cfg(2),
+                         rng=jax.random.PRNGKey(2))
+    eng.train_batch(iter(_batches(1, seed=5)))
+    eng.save_checkpoint(str(tmp_path), tag="async_tag", async_save=True)
+    # keep training immediately — snapshot must be isolated from updates
+    eng.train_batch(iter(_batches(1, seed=6)))
+    wait_pending()
+    assert os.path.exists(tmp_path / "async_tag" / "meta.json")
+
+    e2, *_ = initialize(model=model, config=_cfg(2),
+                        rng=jax.random.PRNGKey(7))
+    tag, _ = e2.load_checkpoint(str(tmp_path), tag="async_tag")
+    assert tag == "async_tag"
+    assert e2.global_steps == 1
+
+
+def test_consolidate_to_fp32(tmp_path, devices):
+    from deepspeed_tpu.checkpoint.store import consolidate_to_fp32
+
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    eng, *_ = initialize(model=model, config=_cfg(2, {"bf16": {"enabled": True}}),
+                         rng=jax.random.PRNGKey(3))
+    eng.train_batch(iter(_batches(1)))
+    eng.save_checkpoint(str(tmp_path))
+    sd = consolidate_to_fp32(str(tmp_path))
+    key = "embed.tokens"
+    assert key in sd and sd[key].dtype == np.float32
+    # fp32 master, not the bf16 params
+    np.testing.assert_allclose(
+        sd[key], np.asarray(jax.device_get(
+            eng.opt_state["master"]["embed"]["tokens"])), rtol=0, atol=0)

@@ -202,3 +202,89 @@ def test_zero_offload_checkpoint_roundtrip(tmp_path, devices):
         e2.train_batch(iter([b]))
     resumed = jax.device_get(e2.params["embed"]["tokens"])
     np.testing.assert_allclose(final, resumed, rtol=1e-6, atol=1e-7)
+
+
+def test_zero_infinity_nvme_matches_device(tmp_path, devices):
+    """ZeRO-Infinity: optimizer tier on NVMe (windowed aio sweep) must
+    track the on-device Adam run, with real disk traffic (VERDICT r1 #3)."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(7)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(4)]
+
+    def run(nvme):
+        build_mesh(data=8)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": 2},
+        }
+        if nvme:
+            cfg["zero_optimization"]["offload_optimizer"] = {
+                "device": "nvme", "nvme_path": str(tmp_path / "swap"),
+                # tiny window -> the model's ~100k params sweep in >=4
+                # windows, exercising the 3-buffer read/compute/write pipe
+                "buffer_size": 32768,
+            }
+        eng, *_ = initialize(model=model, config=cfg,
+                             rng=jax.random.PRNGKey(5))
+        it = iter(batches)
+        losses = [float(eng.train_batch(it)) for _ in range(4)]
+        return eng, losses, jax.device_get(eng.params["embed"]["tokens"])
+
+    e_dev, l_dev, p_dev = run(False)
+    e_nv, l_nv, p_nv = run(True)
+    np.testing.assert_allclose(l_nv, l_dev, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p_nv, p_dev, rtol=1e-4, atol=1e-5)
+    ho = e_nv.host_optimizer
+    n = ho.layout.total
+    # disk traffic: init writes (m,v,master) + per-step read/write of all 3
+    assert ho.bytes_read >= 4 * 3 * n * 4, (ho.bytes_read, n)
+    assert ho.bytes_written >= (4 + 1) * 3 * n * 4
+    assert ho._num_windows() >= 4
+    for f in ho.files.values():
+        assert os.path.getsize(f) >= n * 4 - ho.window * 4
+
+
+def test_zero_infinity_checkpoint_roundtrip(tmp_path, devices):
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    build_mesh(data=8)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1, "offload_optimizer": {
+            "device": "nvme", "nvme_path": str(tmp_path / "swap_a"),
+            "buffer_size": 32768}},
+    }
+    rng = np.random.default_rng(1)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(3)]
+    e1, *_ = initialize(model=model, config=cfg, rng=jax.random.PRNGKey(9))
+    e1.train_batch(iter(batches[:1]))
+    e1.save_checkpoint(str(tmp_path / "ckpt"))
+    for b in batches[1:]:
+        e1.train_batch(iter([b]))
+    final = jax.device_get(e1.params["embed"]["tokens"])
+
+    cfg2 = {**cfg, "zero_optimization": {
+        "stage": 1, "offload_optimizer": {
+            "device": "nvme", "nvme_path": str(tmp_path / "swap_b"),
+            "buffer_size": 32768}}}
+    e2, *_ = initialize(model=model, config=cfg2, rng=jax.random.PRNGKey(0))
+    e2.load_checkpoint(str(tmp_path / "ckpt"))
+    assert e2.host_optimizer.adam.step_count == 1
+    for b in batches[1:]:
+        e2.train_batch(iter([b]))
+    resumed = jax.device_get(e2.params["embed"]["tokens"])
+    np.testing.assert_allclose(final, resumed, rtol=1e-6, atol=1e-7)
