@@ -1,0 +1,247 @@
+"""Paged attention — Pallas TPU kernel over a blocked KV arena.
+
+The TPU-native replacement for the reference FastGen ragged kernels
+(deepspeed/inference/v2/kernels/ragged_ops/: blocked_flash, blocked_kv_
+rotary, logits_gather). The reference gathers paged KV with CUDA kernels
+driven by per-sequence block tables; here the page table is a
+scalar-prefetch operand, so each KV block's DMA source address is computed
+*from the page table itself* inside the BlockSpec index map — the arena is
+never gathered into a contiguous buffer in HBM.
+
+Arena layout (one layer): ``[kv_heads, num_blocks + 1, block_size, head_dim]``.
+The final block is a TRASH block: padded token slots and padded page-table
+entries all point at it, so scatter/gather stay branch-free and
+static-shape. Block size and head_dim are chosen to satisfy the (8, 128)
+tile rule on the last two dims.
+
+Two implementations with identical semantics (tested against each other):
+
+- :func:`paged_attention_xla` — gather + masked softmax in pure XLA.
+  Works everywhere, reference semantics, used for prefill chunks.
+- :func:`paged_attention` — the Pallas kernel; online softmax accumulated
+  across the page grid dimension, per-sequence block skipping via the
+  prefetched context lengths.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Arena plumbing
+# ---------------------------------------------------------------------------
+
+def init_arena(num_layers: int, kv_heads: int, num_blocks: int,
+               block_size: int, head_dim: int, dtype=jnp.bfloat16):
+    """Paged KV arena with one extra trash block per layer.
+
+    Returns {"k": A, "v": A} with A: [L, kvh, num_blocks+1, bs, dh].
+    """
+    shape = (num_layers, kv_heads, num_blocks + 1, block_size, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def write_kv(arena_k: jax.Array, arena_v: jax.Array, k: jax.Array,
+             v: jax.Array, page_table: jax.Array, starts: jax.Array,
+             counts: jax.Array):
+    """Scatter a ragged chunk of new KV into one layer's arena.
+
+    arena_k/arena_v: [kvh, nb+1, bs, dh]; k/v: [n, c, kvh, dh] new tokens
+    (row i valid for j < counts[i]); page_table: [n, mb] physical block ids
+    (padded entries may be anything — padded tokens route to trash);
+    starts: [n] tokens already in KV per sequence.
+    """
+    kvh, nbp1, bs, dh = arena_k.shape
+    n, c, _, _ = k.shape
+    j = jnp.arange(c, dtype=jnp.int32)[None, :]                    # [1, c]
+    pos = starts[:, None] + j                                      # [n, c]
+    logical = pos // bs                                            # [n, c]
+    offset = pos % bs
+    phys = jnp.take_along_axis(page_table, jnp.minimum(
+        logical, page_table.shape[1] - 1), axis=1)                 # [n, c]
+    valid = j < counts[:, None]
+    phys = jnp.where(valid, phys, nbp1 - 1)                        # → trash
+    bi = phys.reshape(-1)
+    oi = offset.reshape(-1)
+    k_rows = k.reshape(n * c, kvh, dh).transpose(1, 0, 2)          # [kvh,nc,dh]
+    v_rows = v.reshape(n * c, kvh, dh).transpose(1, 0, 2)
+    arena_k = arena_k.at[:, bi, oi, :].set(
+        k_rows.astype(arena_k.dtype), mode="drop")
+    arena_v = arena_v.at[:, bi, oi, :].set(
+        v_rows.astype(arena_v.dtype), mode="drop")
+    return arena_k, arena_v
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (also the prefill path)
+# ---------------------------------------------------------------------------
+
+def paged_attention_xla(q: jax.Array, arena_k: jax.Array,
+                        arena_v: jax.Array, page_table: jax.Array,
+                        starts: jax.Array, counts: jax.Array) -> jax.Array:
+    """Gather-then-attend over the paged arena (reference semantics).
+
+    q: [n, c, H, dh] (query rows j >= counts[i] give garbage rows — the
+    caller discards them); arena: [kvh, nb+1, bs, dh]; page_table: [n, mb];
+    starts/counts: [n]. Returns [n, c, H, dh].
+    """
+    kvh, _, bs, dh = arena_k.shape
+    n, c, h, _ = q.shape
+    groups = h // kvh
+    mb = page_table.shape[1]
+
+    # [kvh, n, mb, bs, dh] → [n, kvh, mb*bs, dh]
+    kg = arena_k[:, page_table].transpose(1, 0, 2, 3, 4) \
+        .reshape(n, kvh, mb * bs, dh)
+    vg = arena_v[:, page_table].transpose(1, 0, 2, 3, 4) \
+        .reshape(n, kvh, mb * bs, dh)
+
+    qg = q.reshape(n, c, kvh, groups, dh)
+    s = jnp.einsum("nckgd,nksd->nkgcs", qg, kg.astype(q.dtype),
+                   preferred_element_type=jnp.float32) / math.sqrt(dh)
+    qpos = starts[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [n, c]
+    kpos = jnp.arange(mb * bs, dtype=jnp.int32)                    # [S]
+    ctx = starts + counts                                          # [n]
+    mask = (kpos[None, None] <= qpos[..., None]) & \
+        (kpos[None, None] < ctx[:, None, None])                    # [n, c, S]
+    s = jnp.where(mask[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+    out = jnp.einsum("nkgcs,nksd->nckgd", p, vg)
+    return out.reshape(n, c, h, dh)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (decode / short-chunk path)
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_ref, v_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, block_size: int,
+                  chunk: int, scale: float):
+    """Grid (n_seq, kvh, mb). Online softmax accumulated across the page
+    (last, sequential) grid dimension in VMEM scratch.
+
+    q_ref block: [1, 1, groups*chunk, dh] (rows = g*chunk + j);
+    k_ref/v_ref block: [1, 1, block_size, dh] — the physical block chosen
+    by the prefetched page table in the index map.
+    """
+    s_idx = pl.program_id(0)
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+    rows = q_ref.shape[2]
+
+    @pl.when(b == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    start = starts_ref[s_idx]
+    ctx = start + counts_ref[s_idx]
+
+    @pl.when(b * block_size < ctx)
+    def _compute():
+        q = q_ref[0, 0]                                     # [rows, dh]
+        k_blk = k_ref[0, 0]                                 # [bs, dh]
+        v_blk = v_ref[0, 0]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        r = lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
+        j = lax.rem(r, chunk)                               # query offset
+        qpos = start + j
+        kpos = b * block_size + \
+            lax.broadcasted_iota(jnp.int32, (rows, block_size), 1)
+        s = jnp.where((kpos <= qpos) & (kpos < ctx), s, _NEG_INF)
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        blk_max = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, blk_max)
+        p = jnp.exp(s - m_new[:, None])
+        alive = m_new > _NEG_INF / 2
+        p = jnp.where(alive[:, None], p, 0.0)
+        corr = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1)
+        m_ref[...] = m_new
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
+                    page_table: jax.Array, starts: jax.Array,
+                    counts: jax.Array, *, interpret: bool = False
+                    ) -> jax.Array:
+    """Pallas paged attention. Same contract as :func:`paged_attention_xla`.
+
+    The page table is a scalar-prefetch operand: each (seq, head, page)
+    program's K/V DMA reads block ``page_table[seq, page]`` directly from
+    the arena — no HBM gather. Dead pages (beyond a sequence's context
+    length) skip compute via ``pl.when``; their table entries must point at
+    a real block (e.g. the trash block) so the DMA stays in bounds.
+    """
+    kvh, nbp1, bs, dh = arena_k.shape
+    n, c, h, _ = q.shape
+    groups = h // kvh
+    mb = page_table.shape[1]
+    rows = groups * c
+
+    # [n, c, kvh, g, dh] → [n, kvh, g*c, dh] with row index = g*c + j
+    qk = q.reshape(n, c, kvh, groups, dh).transpose(0, 2, 3, 1, 4) \
+        .reshape(n, kvh, rows, dh)
+
+    grid = (n, kvh, mb)
+    kernel = functools.partial(_paged_kernel, block_size=bs, chunk=c,
+                               scale=1.0 / math.sqrt(dh))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, dh),
+                             lambda s, kh, b, pt, st, ct: (s, kh, 0, 0)),
+                pl.BlockSpec((1, 1, bs, dh),
+                             lambda s, kh, b, pt, st, ct:
+                             (kh, pt[s, b], 0, 0)),
+                pl.BlockSpec((1, 1, bs, dh),
+                             lambda s, kh, b, pt, st, ct:
+                             (kh, pt[s, b], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, rows, dh),
+                lambda s, kh, b, pt, st, ct: (s, kh, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, dh), jnp.float32),
+                pltpu.VMEM((rows,), jnp.float32),
+                pltpu.VMEM((rows,), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, kvh, rows, dh), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), starts.astype(jnp.int32),
+      counts.astype(jnp.int32), qk, arena_k, arena_v)
+
+    # [n, kvh, g*c, dh] → [n, c, h, dh]
+    return out.reshape(n, kvh, groups, c, dh).transpose(0, 3, 1, 2, 4) \
+        .reshape(n, c, h, dh)
+
+
+def supported(chunk: int, groups: int, head_dim: int,
+              block_size: int) -> bool:
+    """Shape gate for the Pallas path (tile rule on the last two dims)."""
+    return head_dim % 128 == 0 and block_size % 8 == 0 and \
+        jax.default_backend() == "tpu"

@@ -1,0 +1,189 @@
+"""Paged attention + ragged engine tests (reference:
+tests/unit/inference/v2/ragged/ + kernels/ragged_ops tests)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.inference.engine_v2 import (RaggedInferenceEngineTPU,
+                                               ragged_forward)
+from deepspeed_tpu.models.llama import llama3_config
+from deepspeed_tpu.ops import paged_attention as pa
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+
+def _random_arena_state(rng, kvh=2, nb=8, bs=16, dh=128, n=3, mb=4):
+    """Build an arena holding random contexts for n sequences."""
+    arena = pa.init_arena(1, kvh, nb, bs, dh, jnp.float32)
+    ak, av = arena["k"][0], arena["v"][0]
+    pt = np.full((n, mb), nb, np.int32)
+    ctxs = [5, 30, 47]                      # straddle block boundaries
+    free = list(range(nb))
+    for i, ctx in enumerate(ctxs):
+        nblk = -(-max(ctx, 1) // bs)
+        blocks = [free.pop(0) for _ in range(nblk)]
+        pt[i, :nblk] = blocks
+        k = rng.standard_normal((1, ctx, kvh, dh)).astype(np.float32)
+        v = rng.standard_normal((1, ctx, kvh, dh)).astype(np.float32)
+        ak, av = pa.write_kv(ak, av, jnp.asarray(k), jnp.asarray(v),
+                             jnp.asarray(pt[i:i + 1]),
+                             jnp.zeros((1,), jnp.int32),
+                             jnp.asarray([ctx], np.int32))
+    return ak, av, pt, np.asarray(ctxs, np.int32)
+
+
+def test_pallas_matches_xla_decode():
+    """Pallas kernel (interpret) vs XLA gather path, single-token decode."""
+    rng = np.random.default_rng(0)
+    kvh, dh, h, n = 2, 128, 4, 3
+    ak, av, pt, starts = _random_arena_state(rng, kvh=kvh, dh=dh, n=n)
+    counts = np.ones((n,), np.int32)
+    k_new = rng.standard_normal((n, 1, kvh, dh)).astype(np.float32)
+    v_new = rng.standard_normal((n, 1, kvh, dh)).astype(np.float32)
+    ak, av = pa.write_kv(ak, av, jnp.asarray(k_new), jnp.asarray(v_new),
+                         jnp.asarray(pt), jnp.asarray(starts),
+                         jnp.asarray(counts))
+    q = rng.standard_normal((n, 1, h, dh)).astype(np.float32)
+    o_xla = pa.paged_attention_xla(jnp.asarray(q), ak, av, jnp.asarray(pt),
+                                   jnp.asarray(starts), jnp.asarray(counts))
+    o_pal = pa.paged_attention(jnp.asarray(q), ak, av, jnp.asarray(pt),
+                               jnp.asarray(starts), jnp.asarray(counts),
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(o_xla), np.asarray(o_pal),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_pallas_matches_xla_chunk():
+    """Prefill-chunk case (c > 1) incl. a fully-padded row (counts == 0)."""
+    rng = np.random.default_rng(1)
+    kvh, dh, h, n, c = 2, 128, 4, 4, 8
+    ak, av, pt3, starts3 = _random_arena_state(rng, kvh=kvh, dh=dh, n=3)
+    nb = ak.shape[1] - 1
+    pt = np.full((n, pt3.shape[1]), nb, np.int32)
+    pt[:3] = pt3
+    starts = np.zeros((n,), np.int32)
+    starts[:3] = starts3
+    counts = np.array([c, c, 3, 0], np.int32)   # ragged + padded row
+    k_new = rng.standard_normal((n, c, kvh, dh)).astype(np.float32)
+    v_new = rng.standard_normal((n, c, kvh, dh)).astype(np.float32)
+    ak, av = pa.write_kv(ak, av, jnp.asarray(k_new), jnp.asarray(v_new),
+                         jnp.asarray(pt), jnp.asarray(starts),
+                         jnp.asarray(counts))
+    q = rng.standard_normal((n, c, h, dh)).astype(np.float32)
+    o_xla = pa.paged_attention_xla(jnp.asarray(q), ak, av, jnp.asarray(pt),
+                                   jnp.asarray(starts), jnp.asarray(counts))
+    o_pal = pa.paged_attention(jnp.asarray(q), ak, av, jnp.asarray(pt),
+                               jnp.asarray(starts), jnp.asarray(counts),
+                               interpret=True)
+    # compare only valid rows/positions
+    for i in range(n):
+        for j in range(counts[i]):
+            np.testing.assert_allclose(np.asarray(o_xla)[i, j],
+                                       np.asarray(o_pal)[i, j],
+                                       rtol=1e-2, atol=1e-2)
+
+
+def test_trash_block_isolation():
+    """Padded-token writes must land in the trash block, never a live one."""
+    kvh, nb, bs, dh = 1, 4, 16, 128
+    arena = pa.init_arena(1, kvh, nb, bs, dh, jnp.float32)
+    ak, av = arena["k"][0], arena["v"][0]
+    pt = np.array([[0, 1]], np.int32)
+    k = jnp.ones((1, 4, kvh, dh), jnp.float32) * 7.0
+    v = jnp.ones((1, 4, kvh, dh), jnp.float32) * 7.0
+    # only 2 of the 4 tokens are valid
+    ak, av = pa.write_kv(ak, av, k, v, jnp.asarray(pt),
+                         jnp.zeros((1,), jnp.int32),
+                         jnp.asarray([2], np.int32))
+    a = np.asarray(ak)
+    assert np.all(a[:, 0, :2] == 7.0)        # valid writes
+    assert np.all(a[:, 0, 2:] == 0.0)        # rest of live block untouched
+    assert np.all(a[:, 1] == 0.0)            # next live block untouched
+    assert np.all(a[:, 2:nb] == 0.0)         # unrelated blocks untouched
+
+
+def test_ragged_forward_matches_cached(devices):
+    """Ragged paged forward == dense KV-cache forward, step by step."""
+    from deepspeed_tpu.models.transformer import (forward_with_cache,
+                                                  init_kv_cache, init_params)
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tok = np.random.default_rng(0).integers(0, 256, size=(1, 12),
+                                            dtype=np.int32)
+
+    bs = 8
+    arena = pa.init_arena(cfg.num_layers, cfg.kv_heads, 8, bs,
+                          cfg.head_dim, jnp.float32)
+    cache = init_kv_cache(cfg, 1, 32, jnp.float32)
+    pt = np.full((1, 4), 8, np.int32)
+    pt[0, :3] = [0, 1, 2]
+
+    # prefill 8 then decode one-by-one, both paths
+    logits_r, arena = ragged_forward(
+        cfg, params, arena, jnp.asarray(tok[:, :8]),
+        jnp.asarray([8], np.int32), jnp.asarray([0], np.int32),
+        jnp.asarray(pt))
+    logits_d, cache = forward_with_cache(cfg, params,
+                                         jnp.asarray(tok[:, :8]), cache,
+                                         jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits_r), np.asarray(logits_d),
+                               rtol=2e-3, atol=2e-3)
+    for i in range(8, 12):
+        logits_r, arena = ragged_forward(
+            cfg, params, arena, jnp.asarray(tok[:, i:i + 1]),
+            jnp.asarray([1], np.int32), jnp.asarray([i], np.int32),
+            jnp.asarray(pt))
+        logits_d, cache = forward_with_cache(
+            cfg, params, jnp.asarray(tok[:, i:i + 1]), cache, jnp.int32(i))
+        np.testing.assert_allclose(np.asarray(logits_r),
+                                   np.asarray(logits_d),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_continuous_batching_matches_v1(devices):
+    """Mixed-length continuous batching must produce token-for-token the
+    same output as solo dense generation (VERDICT #5 'done' criterion)."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    params_rng = jax.random.PRNGKey(3)
+    from deepspeed_tpu.models.transformer import init_params
+    params = init_params(cfg, params_rng)
+
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, 256, size=(n,), dtype=np.int32)
+               for n in (5, 11, 23)]
+
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+              "max_seq_len": 64, "prefill_chunk": 8, "max_batch_tokens": 64},
+        params=params)
+    outs = v2.generate(prompts, max_new_tokens=6)
+
+    v1 = init_inference(cfg, {"dtype": "float32"}, params=params)
+    for p, got in zip(prompts, outs):
+        ref = v1.generate(p[None, :], max_new_tokens=6)[0]
+        np.testing.assert_array_equal(got, ref[:len(p) + 6])
+
+
+def test_block_reuse_after_flush(devices):
+    """Flushing sequences returns pages; the arena supports more total
+    sequences than fit concurrently."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 4, "block_size": 16,
+              "max_seq_len": 32, "prefill_chunk": 16,
+              "max_batch_tokens": 32})
+    rng = np.random.default_rng(5)
+    for wave in range(3):                   # 3 waves x 2 seqs over 4 blocks
+        uids = [wave * 2, wave * 2 + 1]
+        prompts = [rng.integers(0, 256, size=(10,), dtype=np.int32)
+                   for _ in uids]
+        logits = v2.put(uids, prompts)
+        assert set(logits) == set(uids)
+        for u in uids:
+            v2.flush(u)
+    assert v2.state.allocator.free_blocks == 4
