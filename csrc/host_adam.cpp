@@ -132,6 +132,57 @@ void ds_host_adam_step(float* params, const float* grads, float* exp_avg,
   pool().wait();
 }
 
+// Host Adagrad sweep (reference csrc/adagrad/cpu_adagrad.cpp).
+void ds_host_adagrad_step(float* params, const float* grads,
+                          float* exp_avg_sq, int64_t n, float lr, float eps,
+                          float weight_decay) {
+  const int nthreads = pool().size();
+  const int64_t chunk = std::max<int64_t>((n + nthreads - 1) / nthreads,
+                                          1 << 16);
+  for (int64_t off = 0; off < n; off += chunk) {
+    const int64_t len = std::min(chunk, n - off);
+    float* p = params + off;
+    const float* g = grads + off;
+    float* s = exp_avg_sq + off;
+    pool().run([=] {
+      for (int64_t i = 0; i < len; ++i) {
+        float grad = g[i];
+        if (weight_decay != 0.0f) grad += weight_decay * p[i];
+        s[i] += grad * grad;
+        p[i] -= lr * grad / (std::sqrt(s[i]) + eps);
+      }
+    });
+  }
+  pool().wait();
+}
+
+// Host Lion sweep (reference csrc/lion/cpu_lion_impl.cpp): sign of the
+// interpolated momentum, decoupled weight decay.
+void ds_host_lion_step(float* params, const float* grads, float* exp_avg,
+                       int64_t n, float lr, float beta1, float beta2,
+                       float weight_decay) {
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+  const int nthreads = pool().size();
+  const int64_t chunk = std::max<int64_t>((n + nthreads - 1) / nthreads,
+                                          1 << 16);
+  for (int64_t off = 0; off < n; off += chunk) {
+    const int64_t len = std::min(chunk, n - off);
+    float* p = params + off;
+    const float* g = grads + off;
+    float* m = exp_avg + off;
+    pool().run([=] {
+      for (int64_t i = 0; i < len; ++i) {
+        const float c = beta1 * m[i] + one_m_b1 * g[i];
+        const float u = (c > 0.0f) ? 1.0f : ((c < 0.0f) ? -1.0f : 0.0f);
+        p[i] -= lr * (u + weight_decay * p[i]);
+        m[i] = beta2 * m[i] + one_m_b2 * g[i];
+      }
+    });
+  }
+  pool().wait();
+}
+
 // bf16 (stored as uint16) -> fp32 widening copy, vectorizable; used when
 // grads arrive from device in bf16 (reference: cpu_adam half paths).
 void ds_bf16_to_f32(const uint16_t* src, float* dst, int64_t n) {

@@ -1,0 +1,5 @@
+"""Autotuning (reference: deepspeed/autotuning/ — 2,722 LoC Autotuner)."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, TuneResult
+
+__all__ = ["Autotuner", "TuneResult"]

@@ -1,0 +1,125 @@
+"""Autotuner — micro-batch / ZeRO-config search.
+
+Reference: ``autotuning/autotuner.py:42`` (``Autotuner``: builds a space of
+micro-batch sizes × ZeRO stages (+offload), launches short experiment runs,
+ranks by throughput, reports the best config; ``tune()``, model-info
+profiling, FAST mode). The reference orchestrates subprocess experiment
+launches through the DeepSpeed launcher; on TPU a candidate is just an
+engine construction + a few jitted steps in-process — the measurement is
+identical (steps/sec after compile warmup) without the process plumbing.
+
+OOM-safe: a candidate that fails to build or step (RESOURCE_EXHAUSTED) is
+recorded as infeasible and the sweep continues — the reference does the
+same via experiment exit codes.
+"""
+
+import copy
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclass
+class TuneResult:
+    config: Dict[str, Any]
+    throughput: float           #: samples/sec (0 → infeasible)
+    step_time: float
+    error: Optional[str] = None
+
+    @property
+    def feasible(self) -> bool:
+        return self.error is None
+
+
+class Autotuner:
+    """Sweep engine configs, rank by measured throughput (reference
+    Autotuner.tune).
+
+    ``batch_fn(micro_batch_size) -> batch dict`` supplies one microbatch
+    of the right shape per candidate.
+    """
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 batch_fn: Callable[[int], Dict[str, Any]],
+                 micro_batch_sizes: Optional[List[int]] = None,
+                 zero_stages: Optional[List[int]] = None,
+                 steps: int = 5, warmup: int = 2,
+                 rng: Optional[jax.Array] = None):
+        self.model = model
+        self.base_config = base_config
+        self.batch_fn = batch_fn
+        self.micro_batch_sizes = micro_batch_sizes or [1, 2, 4, 8]
+        self.zero_stages = zero_stages or [2, 3]
+        self.steps = steps
+        self.warmup = warmup
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.results: List[TuneResult] = []
+
+    def _candidates(self) -> Iterator[Dict[str, Any]]:
+        for stage in self.zero_stages:
+            for mbs in self.micro_batch_sizes:
+                cfg = copy.deepcopy(self.base_config)
+                cfg["train_micro_batch_size_per_gpu"] = mbs
+                cfg.pop("train_batch_size", None)
+                cfg.setdefault("zero_optimization", {})["stage"] = stage
+                yield cfg
+
+    def _measure(self, cfg: Dict[str, Any]) -> TuneResult:
+        from deepspeed_tpu.parallel.mesh import get_mesh
+        from deepspeed_tpu.runtime.engine import initialize
+        mbs = cfg["train_micro_batch_size_per_gpu"]
+        try:
+            engine, *_ = initialize(model=self.model, config=cfg,
+                                    mesh=get_mesh(), rng=self.rng)
+            batch = self.batch_fn(mbs)
+            gas = int(engine.config.gradient_accumulation_steps)
+            it = lambda: iter([batch] * gas)
+            for _ in range(self.warmup):
+                engine.train_batch(it())
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(self.steps):
+                loss = engine.train_batch(it())
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.steps
+            tput = int(engine.config.train_batch_size) / dt
+            return TuneResult(config=cfg, throughput=tput, step_time=dt)
+        except Exception as e:          # OOM / invalid combo → infeasible
+            logger.warning(f"autotune candidate failed: {e}")
+            return TuneResult(config=cfg, throughput=0.0, step_time=0.0,
+                              error=str(e)[:500])
+
+    def tune(self, results_dir: Optional[str] = None) -> TuneResult:
+        """Run the sweep; returns the best feasible candidate (reference
+        autotuner 'tune' + results json output)."""
+        for cfg in self._candidates():
+            res = self._measure(cfg)
+            self.results.append(res)
+            log_dist(
+                f"autotune: mbs={cfg['train_micro_batch_size_per_gpu']} "
+                f"zero={cfg['zero_optimization']['stage']} → "
+                f"{res.throughput:.1f} samples/s"
+                + (f" (FAILED: {res.error[:60]})" if res.error else ""))
+        feasible = [r for r in self.results if r.feasible]
+        if not feasible:
+            raise RuntimeError("autotuning found no feasible config")
+        best = max(feasible, key=lambda r: r.throughput)
+        if results_dir:
+            os.makedirs(results_dir, exist_ok=True)
+            with open(os.path.join(results_dir, "autotune_results.json"),
+                      "w") as fh:
+                json.dump([{"config": r.config,
+                            "throughput": r.throughput,
+                            "step_time": r.step_time,
+                            "error": r.error} for r in self.results],
+                          fh, indent=1)
+            with open(os.path.join(results_dir, "autotune_best.json"),
+                      "w") as fh:
+                json.dump(best.config, fh, indent=1)
+        return best

@@ -20,14 +20,20 @@ Design:
   happen before training continues); file writes + the meta.json commit
   + the ``latest`` marker run on a background thread through the
   AsyncIOEngine (reference: DecoupledCheckpointEngine, deepspeed/io/
-  fast_file_writer.py). A checkpoint is visible only after its meta.json
-  is fully written — the commit point.
+  fast_file_writer.py). A checkpoint is complete only when EVERY process's
+  ``meta.p<idx>.json`` is present (the loader enforces this via the
+  recorded ``process_count``); the ``latest`` marker is published only
+  after a collective all-processes-committed agreement.
 
-Layout::
+Layout (v2, multi-host)::
 
-    <dir>/<tag>/meta.json                     # meta + fragment index
-    <dir>/<tag>/state/<group>/<leaf>.f<k>.bin # raw C-order fragment bytes
-    <dir>/latest                              # newest committed tag
+    <dir>/<tag>/meta.p<idx>.json   # per-process fragment index; p0's file
+                                   # carries meta + process_count; a save
+                                   # is complete only when ALL process
+                                   # files are present (loader enforces)
+    <dir>/<tag>/meta.json          # p0 alias (back-compat / single-file)
+    <dir>/<tag>/state/<group>/<leaf>.p<idx>f<k>.bin  # raw C-order bytes
+    <dir>/latest                   # newest committed tag (written by p0)
 """
 
 import json
@@ -96,23 +102,66 @@ def _snapshot_shards(leaf) -> List[Tuple[List[int], List[int], np.ndarray]]:
     return out
 
 
+def _agree_ok(ok: bool) -> bool:
+    """All-process AND of a local success flag. Every process calls this at
+    the same point (it doubles as a barrier), so one host's failure raises
+    a collective error everywhere instead of deadlocking the others at a
+    barrier they'll never leave."""
+    if jax.process_count() <= 1:
+        return ok
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if ok else 0], np.int32))
+    return bool(np.all(flags))
+
+
 def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
                     meta: Dict[str, Any], save_latest: bool = True,
                     async_save: bool = False):
     """Write ``state`` (dict of named pytrees) + ``meta`` under tag.
 
+    Multi-host protocol: process 0 clears/creates the tag directory (behind
+    a cross-host barrier), every process writes only its own fragment files
+    plus a per-process ``meta.p<idx>.json`` carrying its fragment index;
+    the loader merges all per-process indexes. Process 0's meta file also
+    records ``process_count`` so an incomplete save is detectable.
+
+    The ``latest`` marker is published only after ALL processes' commits
+    succeed (collective agreement via :func:`_agree_ok`), so auto-resume
+    can never land on a half-written multi-host checkpoint. For async
+    saves that publication happens in :func:`wait_pending` / the next
+    save — both are collective calls every process must reach.
+
     Returns the checkpoint root; with ``async_save`` also returns after the
-    device→host snapshot — call :func:`wait_pending` (or save again) before
-    relying on the files."""
+    device→host snapshot — call :func:`wait_pending` before relying on the
+    files (a failed async commit re-raises there and on the next save)."""
+    # drain previous async commits WITHOUT raising yet: every process must
+    # reach the agreement point or a failure on one host would strand the
+    # others at the barrier
+    first, pubs = _drain_pending()
+    if not _agree_ok(first is None):
+        raise RuntimeError("async checkpoint commit failed (this or a peer "
+                           "process)") from first
+    for ent in pubs:
+        _publish_latest(ent)
     root = os.path.join(save_dir, tag)
-    if os.path.exists(root):
-        shutil.rmtree(root)
-    os.makedirs(os.path.join(root, "state"), exist_ok=True)
+    pidx = jax.process_index()
+    clear_err: Optional[BaseException] = None
+    if pidx == 0:
+        try:
+            if os.path.exists(root):
+                shutil.rmtree(root)
+            os.makedirs(os.path.join(root, "state"), exist_ok=True)
+        except BaseException as e:
+            clear_err = e
+    # doubles as the "nobody writes before p0 cleared the dir" barrier
+    if not _agree_ok(clear_err is None):
+        raise RuntimeError(
+            f"could not clear checkpoint dir {root}") from clear_err
 
     # ---- synchronous snapshot (before donation can invalidate buffers)
     work: List[Tuple[str, np.ndarray]] = []     # (path, host array)
     index: Dict[str, Dict[str, Any]] = {}
-    pidx = jax.process_index()
     for group, tree in state.items():
         gdir = os.path.join(root, "state", group)
         os.makedirs(gdir, exist_ok=True)
@@ -126,37 +175,136 @@ def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
                 work.append((os.path.join(gdir, fname),
                              np.ascontiguousarray(arr)))
                 frags.append({"file": fname, "start": starts, "stop": stops})
-            index.setdefault(group, {})[key] = {
-                "shape": full_shape, "dtype": dtype, "fragments": frags}
+            if frags:       # processes owning no shard of this leaf skip it
+                index.setdefault(group, {})[key] = {
+                    "shape": full_shape, "dtype": dtype, "fragments": frags}
 
     def commit():
         for path, arr in work:
             with open(path, "wb") as fh:
                 fh.write(arr.tobytes())
-        # meta.json last — its presence IS the commit point
-        with open(os.path.join(root, "meta.json"), "w") as fh:
-            json.dump({"meta": meta, "index": index, "version": 2}, fh,
-                      indent=1)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as fh:
-                fh.write(tag)
+        # per-process meta LAST — its presence commits this process's part
+        payload = {"meta": meta, "index": index, "version": 2,
+                   "process_count": jax.process_count()}
+        with open(os.path.join(root, f"meta.p{pidx}.json"), "w") as fh:
+            json.dump(payload, fh, indent=1)
+        if pidx == 0:
+            # back-compat alias (the real commit point is the full set of
+            # per-process meta files; `latest` waits for agreement)
+            with open(os.path.join(root, "meta.json"), "w") as fh:
+                json.dump(payload, fh, indent=1)
 
+    pub = {"save_dir": save_dir, "tag": tag, "save_latest": save_latest}
     if async_save:
-        t = threading.Thread(target=commit, daemon=True)
+        err: List[BaseException] = []
+
+        def run():
+            try:
+                commit()
+            except BaseException as e:     # surfaced by wait_pending
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
         t.start()
-        _PENDING.append(t)
+        _PENDING.append({"thread": t, "err": err, **pub})
         return root
-    commit()
+
+    commit_err: Optional[BaseException] = None
+    try:
+        commit()
+    except BaseException as e:
+        commit_err = e
+    if not _agree_ok(commit_err is None):
+        raise RuntimeError("checkpoint commit failed (this or a peer "
+                           "process)") from commit_err
+    _publish_latest(pub)
     return root
 
 
 #: in-flight async commits (reference: DecoupledCheckpointEngine queue)
-_PENDING: List[threading.Thread] = []
+_PENDING: List[Dict[str, Any]] = []
+
+
+def _publish_latest(ent: Dict[str, Any]) -> None:
+    """Write the ``latest`` marker (p0 only). Callers must have already
+    agreed all processes committed."""
+    if ent["save_latest"] and jax.process_index() == 0:
+        with open(os.path.join(ent["save_dir"], "latest"), "w") as fh:
+            fh.write(ent["tag"])
+
+
+def _drain_pending() -> Tuple[Optional[BaseException], List[Dict[str, Any]]]:
+    """Join in-flight async commits. Returns (first local failure or None,
+    successfully-committed entries awaiting `latest` publication). Never
+    raises — callers run the collective agreement first."""
+    first: Optional[BaseException] = None
+    pubs: List[Dict[str, Any]] = []
+    while _PENDING:
+        ent = _PENDING.pop(0)
+        ent["thread"].join()
+        if ent["err"]:
+            first = first or ent["err"][0]
+        else:
+            pubs.append(ent)
+    return first, pubs
 
 
 def wait_pending() -> None:
-    while _PENDING:
-        _PENDING.pop().join()
+    """Join in-flight async commits; collective across processes. Re-raises
+    the first failure anywhere (the reference surfaces write errors — a
+    checkpoint that silently never committed is worse than a crash); on
+    success publishes the deferred ``latest`` markers."""
+    first, pubs = _drain_pending()
+    if not _agree_ok(first is None):
+        raise RuntimeError("async checkpoint commit failed (this or a peer "
+                           "process)") from first
+    for ent in pubs:
+        _publish_latest(ent)
+
+
+def _read_merged_index(root: str) -> Tuple[Dict[str, Any],
+                                           Dict[str, Dict[str, Any]]]:
+    """Read meta + fragment index, merging every process's
+    ``meta.p<idx>.json`` (v2 multi-host) and falling back to plain
+    ``meta.json`` (v1 / single-file saves)."""
+    pfiles = sorted(f for f in os.listdir(root)
+                    if f.startswith("meta.p") and f.endswith(".json")) \
+        if os.path.isdir(root) else []
+    if not pfiles:
+        meta_path = os.path.join(root, "meta.json")
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(f"no checkpoint at {root}")
+        with open(meta_path) as fh:
+            payload = json.load(fh)
+        return payload["meta"], payload["index"]
+
+    # meta + process_count come from process 0's file per the save
+    # protocol; if p0's file is the missing one, fall back to any present
+    # file (all carry process_count) so the completeness check below can
+    # produce its diagnostic instead of a raw FileNotFoundError
+    meta_src = "meta.p0.json" if "meta.p0.json" in pfiles else pfiles[0]
+    with open(os.path.join(root, meta_src)) as fh:
+        p0 = json.load(fh)
+    meta: Dict[str, Any] = p0["meta"]
+    expected = p0.get("process_count")
+    index: Dict[str, Dict[str, Any]] = {}
+    for fname in pfiles:
+        with open(os.path.join(root, fname)) as fh:
+            payload = json.load(fh)
+        for group, entries in payload["index"].items():
+            gindex = index.setdefault(group, {})
+            for key, entry in entries.items():
+                if key in gindex:
+                    gindex[key]["fragments"].extend(entry["fragments"])
+                else:
+                    gindex[key] = {"shape": entry["shape"],
+                                   "dtype": entry["dtype"],
+                                   "fragments": list(entry["fragments"])}
+    if expected is not None and len(pfiles) != expected:
+        raise RuntimeError(
+            f"incomplete checkpoint at {root}: {len(pfiles)} of "
+            f"{expected} per-process index files present")
+    return meta, index
 
 
 def latest_tag(load_dir: str) -> Optional[str]:
@@ -171,6 +319,13 @@ def _assemble(gdir: str, entry: Dict[str, Any]) -> np.ndarray:
     """Fragments → full np array (any-mesh reshape happens at device_put)."""
     dtype = _np_dtype(entry["dtype"])
     shape = tuple(entry["shape"])
+    if "fragments" not in entry:
+        # version-1 format: one full-shape .npy per leaf
+        if "file" in entry:
+            return np.load(os.path.join(gdir, entry["file"]))
+        raise ValueError(f"unrecognized checkpoint index entry: "
+                         f"{sorted(entry)} (expected 'fragments' [v2] or "
+                         f"'file' [v1])")
     frags = entry["fragments"]
     if len(frags) == 1 and tuple(frags[0]["start"]) == (0,) * len(shape) \
             and tuple(frags[0]["stop"]) == shape:
@@ -197,13 +352,7 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
     if tag is None:
         return None, {}, None
     root = os.path.join(load_dir, tag)
-    meta_path = os.path.join(root, "meta.json")
-    if not os.path.exists(meta_path):
-        raise FileNotFoundError(f"no checkpoint at {root}")
-    with open(meta_path) as fh:
-        payload = json.load(fh)
-    meta = payload["meta"]
-    index = payload["index"]
+    meta, index = _read_merged_index(root)
 
     out: Dict[str, Pytree] = {}
     for group, template in templates.items():
@@ -232,9 +381,7 @@ def consolidate_to_fp32(load_dir: str, tag: Optional[str] = None
     wait_pending()
     tag = tag or latest_tag(load_dir)
     root = os.path.join(load_dir, tag)
-    with open(os.path.join(root, "meta.json")) as fh:
-        payload = json.load(fh)
-    index = payload["index"]
+    _, index = _read_merged_index(root)
     master_keys = {k: v for k, v in index.get("opt_state", {}).items()
                    if k.startswith("master" + _SEP)}
     out = {}

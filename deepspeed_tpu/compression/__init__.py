@@ -1,0 +1,20 @@
+"""Compression subsystem (reference: deepspeed/compression/ — 2,444 LoC:
+``compress.py`` init_compression/redundancy_clean, ``basic_layer.py``
+QAT/pruning layer rewrites, ``scheduler.py`` compression scheduler)."""
+
+from deepspeed_tpu.compression.compress import (CompressionState,
+                                                apply_compression,
+                                                init_compression,
+                                                redundancy_clean,
+                                                update_masks)
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.compression.transforms import (activation_fake_quant,
+                                                  head_prune_mask,
+                                                  magnitude_prune_mask,
+                                                  weight_fake_quant)
+
+__all__ = ["CompressionConfig", "CompressionScheduler", "CompressionState",
+           "init_compression", "apply_compression", "redundancy_clean",
+           "update_masks", "weight_fake_quant", "activation_fake_quant",
+           "magnitude_prune_mask", "head_prune_mask"]

@@ -1,0 +1,118 @@
+"""Compression top-level API (reference: compression/compress.py —
+``init_compression``:*, ``redundancy_clean``).
+
+The reference walks an nn.Module and swaps layers for compressed variants;
+here compression is a pure params→params transform composed into the loss
+function:
+
+    ccfg = CompressionConfig(**ds_config["compression_training"])
+    state = init_compression(params, ccfg)
+    sched = CompressionScheduler(ccfg)
+
+    def loss_fn(params, batch, rng):
+        sched_w = sched.weight_quant()            # host-side, static
+        p = apply_compression(params, state, wq_bits=sched_w.bits if
+                              sched_w.active else None, prune=True)
+        return base_loss(p, batch, rng)
+
+Masks live OUTSIDE the optimizer state (the reference keeps them as module
+buffers): gradients flow through the masked forward via the straight-
+through estimator, the optimizer updates dense weights, and
+``redundancy_clean`` bakes the masks in at export time.
+"""
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression.transforms import (magnitude_prune_mask,
+                                                  weight_fake_quant)
+
+Pytree = Any
+
+
+@dataclass
+class CompressionState:
+    """Per-leaf pruning masks + which leaves each method touches."""
+    masks: Dict[str, jax.Array] = field(default_factory=dict)
+    wq_keys: tuple = ()
+    prune_keys: tuple = ()
+
+
+def _leaf_items(params: Pytree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in path)
+        yield key, leaf
+
+
+def _matches(key: str, patterns) -> bool:
+    return any(fnmatch.fnmatch(key, pat) or pat == "*" for pat in patterns)
+
+
+def _eligible(leaf) -> bool:
+    return jnp.ndim(leaf) >= 2 and jnp.issubdtype(
+        jnp.asarray(leaf).dtype, jnp.floating)
+
+
+def init_compression(params: Pytree, config: CompressionConfig
+                     ) -> CompressionState:
+    """Select target leaves and build initial masks (reference
+    init_compression layer-swap walk)."""
+    wq_keys, prune_keys, masks = [], [], {}
+    for key, leaf in _leaf_items(params):
+        if not _eligible(leaf):
+            continue
+        if config.weight_quantization.enabled and \
+                _matches(key, config.weight_quantization.modules):
+            wq_keys.append(key)
+        if config.sparse_pruning.enabled and \
+                _matches(key, config.sparse_pruning.modules):
+            prune_keys.append(key)
+            masks[key] = jnp.ones(jnp.shape(leaf),
+                                  jnp.asarray(leaf).dtype)
+    return CompressionState(masks=masks, wq_keys=tuple(wq_keys),
+                            prune_keys=tuple(prune_keys))
+
+
+def update_masks(params: Pytree, state: CompressionState,
+                 config: CompressionConfig) -> CompressionState:
+    """Recompute magnitude masks from current weights (called when the
+    scheduler reports refresh_due; reference frequency semantics)."""
+    ratio = config.sparse_pruning.dense_ratio
+    new = dict(state.masks)
+    lookup = dict(_leaf_items(params))
+    for key in state.prune_keys:
+        new[key] = magnitude_prune_mask(lookup[key], ratio)
+    return CompressionState(masks=new, wq_keys=state.wq_keys,
+                            prune_keys=state.prune_keys)
+
+
+def apply_compression(params: Pytree, state: CompressionState,
+                      wq_bits: Optional[int] = None, wq_groups: int = 1,
+                      prune: bool = False) -> Pytree:
+    """Forward-time transform: mask pruned weights, fake-quant QAT
+    weights. jit-safe (activity is static per trace)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in path)
+        x = leaf
+        if prune and key in state.prune_keys and key in state.masks:
+            x = x * state.masks[key]
+        if wq_bits is not None and key in state.wq_keys:
+            x = weight_fake_quant(x, bits=wq_bits, groups=wq_groups)
+        out.append(x)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def redundancy_clean(params: Pytree, state: CompressionState) -> Pytree:
+    """Bake masks into the weights for export (reference
+    redundancy_clean)."""
+    return apply_compression(params, state, wq_bits=None, prune=True)

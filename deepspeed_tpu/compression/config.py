@@ -1,0 +1,67 @@
+"""Compression config (reference: compression/config.py +
+``get_compression_config`` runtime/config.py:794 — same JSON schema keys,
+flattened to the knobs the TPU path implements)."""
+
+from typing import List, Optional
+
+from deepspeed_tpu.config.config_utils import TPUConfigModel
+
+
+class WeightQuantizationConfig(TPUConfigModel):
+    enabled: bool = False
+    start_bits: int = 8
+    target_bits: int = 8
+    quantize_period: int = 100          #: steps between bit reductions
+    quantize_groups: int = 1            #: per-tensor groups
+    schedule_offset: int = 0            #: step at which QAT starts
+    modules: List[str] = ["*"]          #: leaf-name glob filter
+
+
+class ActivationQuantizationConfig(TPUConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    range_calibration: str = "dynamic"  #: dynamic absmax per batch
+    schedule_offset: int = 0
+    modules: List[str] = ["*"]
+
+
+class SparsePruningConfig(TPUConfigModel):
+    enabled: bool = False
+    method: str = "l1"                  #: magnitude pruning
+    dense_ratio: float = 0.5            #: fraction of weights KEPT
+    frequency: int = 100                #: mask refresh period (steps)
+    schedule_offset: int = 0
+    modules: List[str] = ["*"]
+
+
+class HeadPruningConfig(TPUConfigModel):
+    enabled: bool = False
+    num_heads: int = 0                  #: heads to KEEP (0 = all)
+    dense_ratio: float = 1.0
+    schedule_offset: int = 0
+    modules: List[str] = ["*"]
+
+
+class LayerReductionConfig(TPUConfigModel):
+    enabled: bool = False
+    keep_number_layer: int = 0
+    teacher_layer: List[int] = []
+
+
+class CompressionConfig(TPUConfigModel):
+    """Reference compression JSON block (compression/constants.py names)."""
+    weight_quantization: WeightQuantizationConfig = \
+        WeightQuantizationConfig()
+    activation_quantization: ActivationQuantizationConfig = \
+        ActivationQuantizationConfig()
+    sparse_pruning: SparsePruningConfig = SparsePruningConfig()
+    head_pruning: HeadPruningConfig = HeadPruningConfig()
+    layer_reduction: LayerReductionConfig = LayerReductionConfig()
+
+    @property
+    def any_enabled(self) -> bool:
+        return (self.weight_quantization.enabled or
+                self.activation_quantization.enabled or
+                self.sparse_pruning.enabled or
+                self.head_pruning.enabled or
+                self.layer_reduction.enabled)

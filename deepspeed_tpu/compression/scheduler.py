@@ -1,0 +1,58 @@
+"""Compression scheduler (reference: compression/scheduler.py —
+``CompressionScheduler`` drives schedule_offset / frequency / progressive
+bit reduction per compression method)."""
+
+from dataclasses import dataclass
+
+from deepspeed_tpu.compression.config import CompressionConfig
+
+
+@dataclass
+class MethodState:
+    active: bool = False
+    bits: int = 32          #: current quantization bits (progressive)
+    refresh_due: bool = False
+
+
+class CompressionScheduler:
+    """Tracks the training step and answers, per method: is it active,
+    at what strength, and is a mask refresh due this step."""
+
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self.step = 0
+
+    def advance(self, step: int) -> None:
+        self.step = int(step)
+
+    # -- per-method queries --------------------------------------------------
+
+    def weight_quant(self) -> MethodState:
+        c = self.config.weight_quantization
+        if not c.enabled or self.step < c.schedule_offset:
+            return MethodState()
+        # progressive bit reduction: start_bits → target_bits, one bit
+        # every quantize_period steps (reference quantize_period semantics)
+        steps_in = self.step - c.schedule_offset
+        drop = min(c.start_bits - c.target_bits,
+                   steps_in // max(c.quantize_period, 1))
+        return MethodState(active=True, bits=c.start_bits - drop)
+
+    def activation_quant(self) -> MethodState:
+        c = self.config.activation_quantization
+        if not c.enabled or self.step < c.schedule_offset:
+            return MethodState()
+        return MethodState(active=True, bits=c.bits)
+
+    def sparse_prune(self) -> MethodState:
+        c = self.config.sparse_pruning
+        if not c.enabled or self.step < c.schedule_offset:
+            return MethodState()
+        due = (self.step - c.schedule_offset) % max(c.frequency, 1) == 0
+        return MethodState(active=True, refresh_due=due)
+
+    def head_prune(self) -> MethodState:
+        c = self.config.head_pruning
+        if not c.enabled or self.step < c.schedule_offset:
+            return MethodState()
+        return MethodState(active=True)

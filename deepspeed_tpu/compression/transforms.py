@@ -1,0 +1,89 @@
+"""Compression primitives — QAT fake-quant (straight-through estimator),
+magnitude/structured pruning masks.
+
+Reference: ``compression/basic_layer.py`` (LinearLayer_Compress:
+quantization :372–420, sparse/head/channel pruning :200–330) and
+``compression/utils.py`` quantizers. The reference rewrites nn.Modules;
+here every transform is a pure function applied to weights/activations
+inside the loss function — XLA fuses the fake-quant into the surrounding
+matmuls, so QAT costs almost nothing on TPU.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ste(x: jax.Array, qx: jax.Array) -> jax.Array:
+    """Straight-through estimator: forward = qx, gradient = identity
+    (reference utils.py SymQuantizer.forward's detach trick)."""
+    return x + lax.stop_gradient(qx - x)
+
+
+def weight_fake_quant(w: jax.Array, bits: int = 8, groups: int = 1
+                     ) -> jax.Array:
+    """Symmetric per-group QAT fake quantization of a weight tensor."""
+    if bits >= 16:
+        return w
+    qmax = 2.0 ** (bits - 1) - 1
+    flat = w.reshape(groups, -1)
+    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(flat / safe), -qmax, qmax) * safe
+    return _ste(w, q.reshape(w.shape).astype(w.dtype))
+
+
+def activation_fake_quant(x: jax.Array, bits: int = 8) -> jax.Array:
+    """Dynamic-range symmetric activation fake quant (reference
+    activation_quantization 'dynamic' calibration)."""
+    if bits >= 16:
+        return x
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(x)) / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -qmax, qmax) * safe
+    return _ste(x, q.astype(x.dtype))
+
+
+def magnitude_prune_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Keep the top ``dense_ratio`` fraction of |w| (reference l1-method
+    sparse pruning). Returns a {0,1} mask of w's shape."""
+    k = max(1, int(round(w.size * dense_ratio)))
+    flat = jnp.abs(w.reshape(-1))
+    # threshold = k-th largest magnitude
+    thresh = lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def head_prune_mask(wo_like: jax.Array, num_heads: int, keep: int
+                    ) -> jax.Array:
+    """Structured head pruning for an attention output projection whose
+    leading dim is [H * Dh] (reference head_pruning on attn.out_proj):
+    score heads by L2 norm, keep the top ``keep``. Returns a [H] {0,1}
+    mask."""
+    h = num_heads
+    per_head = wo_like.reshape(h, -1)
+    scores = jnp.sqrt(jnp.sum(jnp.square(per_head.astype(jnp.float32)),
+                              axis=1))
+    if keep >= h:
+        return jnp.ones((h,), wo_like.dtype)
+    thresh = lax.top_k(scores, keep)[0][-1]
+    return (scores >= thresh).astype(wo_like.dtype)
+
+
+def channel_prune_mask(w: jax.Array, dense_ratio: float, axis: int = 0
+                       ) -> jax.Array:
+    """Structured channel pruning: L2-score along ``axis``, keep the top
+    fraction (reference channel_pruning). Mask broadcastable to w."""
+    moved = jnp.moveaxis(w, axis, 0)
+    scores = jnp.sqrt(jnp.sum(
+        jnp.square(moved.reshape(moved.shape[0], -1).astype(jnp.float32)),
+        axis=1))
+    keep = max(1, int(round(scores.shape[0] * dense_ratio)))
+    thresh = lax.top_k(scores, keep)[0][-1]
+    mask1d = (scores >= thresh).astype(w.dtype)
+    shape = [1] * w.ndim
+    shape[axis] = w.shape[axis]
+    return mask1d.reshape(shape)

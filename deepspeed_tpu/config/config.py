@@ -165,6 +165,11 @@ class ZeroConfig(TPUConfigModel):
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_hpz_partition_size: int = 1   # hpZ secondary shard group size (MiCS-like)
+    #: MiCS (reference runtime/zero/mics.py): stage-3 param shards live
+    #: within a sub-group of this size ('data_inner' mesh axis) and
+    #: replicate across the outer data axis — group-local allgathers.
+    #: 0/1 = off.
+    mics_shard_size: int = 0
     #: log a warning then ignore knobs that XLA subsumes
     model_config = TPUConfigModel.model_config
 

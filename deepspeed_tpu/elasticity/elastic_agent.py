@@ -1,0 +1,107 @@
+"""Elastic agent — preemption-aware checkpoint/resume.
+
+Reference: ``elasticity/elastic_agent.py:32`` (``DSElasticAgent`` plugging
+into torchelastic: monitors workers, restarts within ``max_restarts``).
+TPU pods get PREEMPTED (maintenance events / spot reclaims deliver
+SIGTERM), so the TPU-native agent's job is: catch the signal, commit a
+checkpoint at the next step boundary, exit cleanly, and on relaunch resume
+from `latest` — plus an in-process restart loop for transient failures
+(the analogue of torchelastic's worker-group restarts; multi-host
+relaunch itself is the launcher's job, launcher/runner.py).
+"""
+
+import signal
+import sys
+from typing import Any, Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class Preempted(SystemExit):
+    """Raised at a step boundary after SIGTERM; carries the saved tag."""
+
+    def __init__(self, tag: Optional[str]):
+        self.tag = tag
+        super().__init__(143)
+
+
+class DSElasticAgent:
+    """Wrap an engine with signal-driven checkpointing.
+
+    Usage::
+
+        agent = DSElasticAgent(engine, save_dir)
+        agent.install()                 # SIGTERM/SIGUSR1 handlers
+        agent.resume()                  # load `latest` if present
+        for batch in data:
+            engine.train_batch(...)
+            agent.step_boundary()       # raises Preempted after a signal
+    """
+
+    def __init__(self, engine, save_dir: str,
+                 save_on: tuple = (signal.SIGTERM,)):
+        self.engine = engine
+        self.save_dir = save_dir
+        self.save_on = save_on
+        self._signaled = False
+        self._prev_handlers: Dict[int, Any] = {}
+
+    def install(self) -> None:
+        for sig in self.save_on:
+            self._prev_handlers[sig] = signal.signal(sig, self._handler)
+        log_dist(f"elastic agent armed on signals "
+                 f"{[signal.Signals(s).name for s in self.save_on]}")
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+
+    def _handler(self, signum, frame) -> None:
+        logger.warning(f"elastic agent: received "
+                       f"{signal.Signals(signum).name}; will checkpoint "
+                       f"at the next step boundary")
+        self._signaled = True
+
+    @property
+    def preemption_pending(self) -> bool:
+        return self._signaled
+
+    def step_boundary(self) -> None:
+        """Call once per training step; commits + raises on a pending
+        signal (the reference agent stops the worker group the same
+        way)."""
+        if not self._signaled:
+            return
+        tag = f"preempt_step{self.engine.global_steps}"
+        self.engine.save_checkpoint(self.save_dir, tag=tag)
+        log_dist(f"elastic agent: checkpoint '{tag}' committed, exiting")
+        raise Preempted(tag)
+
+    def resume(self) -> Optional[str]:
+        """Load the newest checkpoint if one exists (relaunch path)."""
+        tag, _ = self.engine.load_checkpoint(self.save_dir)
+        if tag:
+            log_dist(f"elastic agent: resumed from '{tag}' at step "
+                     f"{self.engine.global_steps}")
+        return tag
+
+
+def run_elastic(train_fn: Callable[[int], Any], max_restarts: int = 3
+                ) -> Any:
+    """In-process restart loop (reference DSElasticAgent._invoke_run:127
+    restart-on-failure semantics). ``train_fn(attempt)`` should build its
+    engine, ``resume()``, and train; transient exceptions trigger a
+    restart up to ``max_restarts``; ``Preempted`` exits cleanly."""
+    last: Optional[BaseException] = None
+    for attempt in range(max_restarts + 1):
+        try:
+            return train_fn(attempt)
+        except Preempted:
+            raise
+        except BaseException as e:      # noqa: BLE001 — restart policy
+            last = e
+            logger.warning(f"elastic restart {attempt + 1}/{max_restarts} "
+                           f"after: {e}")
+    raise RuntimeError(
+        f"training failed after {max_restarts} restarts") from last

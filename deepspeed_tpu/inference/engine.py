@@ -109,10 +109,22 @@ class InferenceEngineTPU:
         kv_h = "model" if (tp and model.kv_heads % self.mesh.shape["model"]
                            == 0) else None
         self._cache_sh = NamedSharding(
-            self.mesh, P(None, ("data", "expert"), None, kv_h, None))
+            self.mesh, P(None, ("data", "data_inner", "expert"), None,
+                         kv_h, None))
 
+        # MoE models route every token deterministically at inference
+        # (full capacity, no dropping — reference MoE inference EP,
+        # inference/engine.py:260 _create_ep_parallel_group)
+        self._moe_fn = None
+        if model.num_experts:
+            from deepspeed_tpu.parallel.moe import moe_layer
+            self._moe_fn = partial(
+                moe_layer, top_k=model.num_experts_per_tok,
+                drop_tokens=False, aux_loss_coef=0.0,
+                ep_axis="expert" if self.mesh.shape["expert"] > 1
+                else None)
         self._step = jax.jit(
-            partial(forward_with_cache, model),
+            partial(forward_with_cache, model, moe_fn=self._moe_fn),
             donate_argnums=(2,))
         self._samplers: Dict[Tuple[float, int, float], Any] = {}
         log_dist(f"inference engine ready: tp={self.mesh.shape['model']} "
@@ -130,7 +142,8 @@ class InferenceEngineTPU:
     def _new_cache(self, batch: int, max_len: int):
         cache = init_kv_cache(self.model_config, batch, max_len, self.dtype)
         sh = self._cache_sh
-        dp = self.mesh.shape["data"] * self.mesh.shape["expert"]
+        dp = self.mesh.shape["data"] * self.mesh.shape["data_inner"] * \
+            self.mesh.shape["expert"]
         if batch % dp:
             # batch doesn't divide the DP axes (e.g. serving a single
             # prompt on a training mesh): replicate the batch dim

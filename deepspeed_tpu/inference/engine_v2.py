@@ -49,7 +49,8 @@ class RaggedInferenceConfig(TPUConfigModel):
 
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
                    counts: jax.Array, starts: jax.Array,
-                   page_table: jax.Array, use_pallas: bool = False):
+                   page_table: jax.Array, use_pallas: bool = False,
+                   moe_fn=None):
     """One forward over a ragged batch against the paged KV arena.
 
     tokens: [n, c] (row i valid for j < counts[i]); starts: [n] tokens
@@ -78,7 +79,11 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
         ak, av = pa.write_kv(ak, av, k, v, page_table, starts, counts)
         out = attend(q, ak, av, page_table, starts, counts)
         h = x + attn_out_project(cfg, lp["attn"], out)
-        ff = _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h))
+        normed = _norm(cfg, lp["ln2"], h)
+        if cfg.num_experts and moe_fn is not None:
+            ff, _ = moe_fn(cfg, lp["moe"], normed)
+        else:
+            ff = _mlp(cfg, lp["mlp"], normed)
         return h + ff, (ak, av)
 
     x, (ak, av) = lax.scan(body, x, (params["layers"], arena["k"],
@@ -112,8 +117,7 @@ class RaggedInferenceEngineTPU:
         self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                       "float16": jnp.float16}[config.dtype]
         if config.use_pallas is None:
-            self.use_pallas = pa.supported(1, model.num_heads //
-                                           model.kv_heads, model.head_dim,
+            self.use_pallas = pa.supported(model.head_dim,
                                            config.block_size)
         else:
             self.use_pallas = bool(config.use_pallas)
@@ -135,8 +139,15 @@ class RaggedInferenceEngineTPU:
         self.arena = pa.init_arena(model.num_layers, model.kv_heads,
                                    config.num_blocks, config.block_size,
                                    model.head_dim, self.dtype)
+        moe_fn = None
+        if model.num_experts:
+            from deepspeed_tpu.parallel.moe import moe_layer
+            from functools import partial as _p
+            moe_fn = _p(moe_layer, top_k=model.num_experts_per_tok,
+                        drop_tokens=False, aux_loss_coef=0.0, ep_axis=None)
         self._fwd = jax.jit(
-            partial(ragged_forward, model, use_pallas=self.use_pallas),
+            partial(ragged_forward, model, use_pallas=self.use_pallas,
+                    moe_fn=moe_fn),
             donate_argnums=(1,))
         log_dist(f"ragged engine ready: blocks={config.num_blocks}x"
                  f"{config.block_size} pallas={self.use_pallas} "
@@ -162,6 +173,22 @@ class RaggedInferenceEngineTPU:
         """Queue new tokens, then run engine steps until every queued token
         has been consumed; returns {uid: last-token logits} for sequences
         whose pending tokens were exhausted this call."""
+        # enforce max_seq_len up front: past it the page table row would
+        # overflow (and write_kv's index clamp would misroute KV silently).
+        # Totals accumulate WITHIN this call too, so duplicate uids in one
+        # put() can't slip past the check.
+        pending: Dict[int, int] = {}
+        for uid, toks in zip(uids, tokens_list):
+            have = pending.get(
+                uid, len(self.state.seqs[uid].tokens)
+                if uid in self.state.seqs else 0)
+            total = have + len(np.asarray(toks).reshape(-1))
+            if total > self.config.max_seq_len:
+                raise ValueError(
+                    f"sequence {uid} would reach {total} tokens, over "
+                    f"max_seq_len={self.config.max_seq_len}; flush it or "
+                    f"raise max_seq_len")
+            pending[uid] = total
         self.scheduler.put(uids, tokens_list)
         out: Dict[int, np.ndarray] = {}
         while True:
@@ -213,7 +240,11 @@ class RaggedInferenceEngineTPU:
         1-D int arrays (ragged lengths). Returns the full token sequences.
         Sequences join/leave the batch independently — the continuous
         batching the padded v1 engine can't do."""
-        uids = list(range(len(prompts)))
+        # allocate uids that can't collide with sequences the streaming
+        # put() API may already hold (review finding: generate() after
+        # put([0], ...) silently extended sequence 0)
+        base = max(self.state.seqs.keys(), default=-1) + 1
+        uids = [base + i for i in range(len(prompts))]
         seqs = {u: list(np.asarray(p).reshape(-1).astype(np.int32))
                 for u, p in zip(uids, prompts)}
         remaining = {u: max_new_tokens for u in uids}

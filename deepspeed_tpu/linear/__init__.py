@@ -1,0 +1,15 @@
+"""OptimizedLinear — LoRA + quantized-base linear (reference:
+deepspeed/linear/optimized_linear.py:18)."""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.optimized_linear import (apply_optimized_linear,
+                                                   merge_params,
+                                                   split_params,
+                                                   init_optimized_linear,
+                                                   lora_partition_specs,
+                                                   merge_lora,
+                                                   trainable_mask)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "init_optimized_linear",
+           "apply_optimized_linear", "lora_partition_specs", "merge_lora",
+           "trainable_mask", "split_params", "merge_params"]

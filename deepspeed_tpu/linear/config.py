@@ -1,0 +1,30 @@
+"""Configs for OptimizedLinear (reference: deepspeed/linear/config.py:13
+``LoRAConfig``, :39 ``QuantizationConfig``)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Reference linear/config.py:13.
+
+    ``base_weight_sharding``: how many ways the FROZEN base weight is
+    sharded over the 'data' (fsdp) axis — the reference shards the base
+    across ranks and gathers on use; on TPU the partition spec does the
+    same through XLA.
+    """
+    lora_r: int = 8
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    #: delay LoRA grad sync until this many tokens (parity knob; XLA
+    #: handles sync placement — kept for config compat)
+    offload_ratio: float = 0.0
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference linear/config.py:39: frozen-base weight quantization."""
+    q_bits: int = 8
+    group_size: int = 256
+    #: quantize only the frozen base (LoRA adapters stay high precision)
+    mantissa_bits: int = 3   # parity field (fp6 path in the reference)

@@ -1,0 +1,154 @@
+"""OptimizedLinear — LoRA adapters over a (optionally quantized) frozen base.
+
+Reference: ``deepspeed/linear/optimized_linear.py:18`` (``OptimizedLinear``
+dispatcher, ``LoRAOptimizedLinear``:76) and ``linear/quantization.py:18,129``
+(``QuantizedParameter``/``QuantizedLinear``). The reference subclasses
+nn.Linear, shards the frozen base across ranks, and dequantizes in forward;
+here the layer is a pure function over a params pytree:
+
+- ``base`` is FROZEN (``lax.stop_gradient``) and optionally stored
+  block-quantized int8 (ops/quantizer.py) — 4× less HBM than fp32, 2× less
+  than bf16; dequantize fuses into the matmul epilogue under jit.
+- ``lora_a [r, in]`` / ``lora_b [out, r]`` are the trainable adapters;
+  output = x @ baseᵀ + (alpha/r) · x @ lora_aᵀ @ lora_bᵀ.
+- sharding: the base weight's PartitionSpec puts the out-dim on the fsdp
+  axis when ``base_weight_sharding > 1`` (the reference's sharded frozen
+  base); adapters replicate (they're tiny).
+
+``merge_lora`` folds the adapters into the base (the reference hybrid
+engine's LoRA fuse, runtime/hybrid_engine.py:132) for serving.
+"""
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.ops.quantizer import dequantize_blocks, quantize_blocks
+
+Params = Dict[str, Any]
+
+
+def init_optimized_linear(rng: jax.Array, in_features: int,
+                          out_features: int,
+                          lora: Optional[LoRAConfig] = None,
+                          quant: Optional[QuantizationConfig] = None,
+                          base: Optional[jax.Array] = None,
+                          dtype=jnp.float32) -> Params:
+    """Build the params pytree. ``base`` (a pretrained [out, in] weight)
+    may be passed in; otherwise kaiming-init."""
+    ra, rb = jax.random.split(rng)
+    if base is None:
+        base = jax.random.normal(ra, (out_features, in_features), dtype) \
+            * (1.0 / math.sqrt(in_features))
+    base = base.astype(dtype)
+    p: Params = {}
+    if quant is not None:
+        if quant.q_bits != 8:
+            raise ValueError("OptimizedLinear quantized base supports int8 "
+                             "(reference default); use ops/quantizer "
+                             "directly for int4")
+        total = out_features * in_features
+        if total % quant.group_size:
+            raise ValueError(
+                f"out*in ({total}) must be divisible by group_size "
+                f"({quant.group_size})")
+        q, s, _ = quantize_blocks(base.reshape(-1), block=quant.group_size,
+                                  bits=8)
+        # natural [out, in] int8 so shape metadata lives in the array;
+        # group size is recoverable as q.size // scales.size
+        p["base_q"] = q.reshape(out_features, in_features)
+        p["base_scales"] = s
+    else:
+        p["base"] = base
+    if lora is not None and lora.lora_r > 0:
+        r = lora.lora_r
+        # reference init: A ~ kaiming, B = 0 (adapter starts as identity)
+        p["lora_a"] = jax.random.normal(rb, (r, in_features), dtype) \
+            * (1.0 / math.sqrt(in_features))
+        p["lora_b"] = jnp.zeros((out_features, r), dtype)
+    return p
+
+
+def _materialize_base(p: Params, quant: Optional[QuantizationConfig],
+                      dtype) -> jax.Array:
+    if "base" in p:
+        return p["base"].astype(dtype)
+    q = p["base_q"]
+    group = q.size // p["base_scales"].size
+    flat = dequantize_blocks(q.reshape(-1), p["base_scales"], block=group,
+                             bits=8, dtype=dtype)
+    return flat.reshape(q.shape)
+
+
+def apply_optimized_linear(p: Params, x: jax.Array,
+                           lora: Optional[LoRAConfig] = None,
+                           quant: Optional[QuantizationConfig] = None
+                           ) -> jax.Array:
+    """x: [..., in] → [..., out]. Base path is stop-gradiented — only the
+    adapters train (reference: base requires_grad=False)."""
+    w = _materialize_base(p, quant, x.dtype)
+    out = x @ lax.stop_gradient(w).T
+    if "lora_a" in p:
+        r = p["lora_a"].shape[0]
+        alpha = lora.lora_alpha if lora is not None else float(r)
+        scaling = alpha / r
+        out = out + scaling * ((x @ p["lora_a"].T) @ p["lora_b"].T)
+    return out
+
+
+def lora_partition_specs(p: Params, lora: Optional[LoRAConfig] = None
+                         ) -> Params:
+    """PartitionSpec pytree: shard the big frozen base over the fsdp axis
+    when configured; adapters replicate."""
+    shard = lora is not None and lora.base_weight_sharding > 1
+    fsdp = ("data", "data_inner", "expert") if shard else None
+    specs: Params = {}
+    for k, v in p.items():
+        if k in ("base", "base_q"):
+            specs[k] = P(fsdp, None)
+        elif k == "base_scales":
+            specs[k] = P(fsdp)
+        else:
+            specs[k] = P(*([None] * jnp.ndim(v)))
+    return specs
+
+
+def trainable_mask(p: Params) -> Params:
+    """True for leaves the optimizer should update (adapters only when
+    LoRA is present — the reference freezes the base)."""
+    has_lora = "lora_a" in p
+    return {k: (k.startswith("lora_") if has_lora else True) for k in p}
+
+
+def split_params(p: Params) -> Tuple[Params, Params]:
+    """(trainable, frozen) split for ``jax.grad``: int8/frozen leaves can't
+    be grad inputs, so differentiate the trainable dict with the frozen
+    dict closed over::
+
+        trainable, frozen = split_params(p)
+        grads = jax.grad(lambda tr: loss(merge_params(tr, frozen)))(trainable)
+    """
+    mask = trainable_mask(p)
+    return ({k: v for k, v in p.items() if mask[k]},
+            {k: v for k, v in p.items() if not mask[k]})
+
+
+def merge_params(trainable: Params, frozen: Params) -> Params:
+    return {**frozen, **trainable}
+
+
+def merge_lora(p: Params, lora: LoRAConfig,
+               quant: Optional[QuantizationConfig] = None) -> jax.Array:
+    """Fold adapters into a dense [out, in] weight (hybrid-engine LoRA
+    fuse, reference runtime/hybrid_engine.py:132-146)."""
+    w = _materialize_base(p, quant, jnp.float32)
+    if "lora_a" in p:
+        scaling = lora.lora_alpha / lora.lora_r
+        w = w + scaling * (p["lora_b"].astype(jnp.float32) @
+                           p["lora_a"].astype(jnp.float32))
+    return w

@@ -1,0 +1,21 @@
+"""Mistral family presets (reference: inference/v2/model_implementations/
+mistral/ — same decoder family as Llama with sliding-window-free GQA
+config; HF-loadable via models/hf_loader.py)."""
+
+from deepspeed_tpu.models.transformer import DecoderConfig
+
+
+def mistral_config(size: str = "7b", **overrides) -> DecoderConfig:
+    presets = {
+        "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128, vocab_size=512,
+                     max_seq_len=256),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                   num_kv_heads=8, intermediate_size=14336),
+    }
+    base = dict(vocab_size=32000, max_seq_len=8192, norm="rmsnorm",
+                activation="silu_glu", pos_emb="rope", rope_theta=10000.0,
+                use_bias=False, tie_embeddings=False, norm_eps=1e-5)
+    base.update(presets[size])
+    base.update(overrides)
+    return DecoderConfig(**base)

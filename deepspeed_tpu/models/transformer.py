@@ -553,7 +553,7 @@ def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 
 def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
-                    tp: bool = False) -> Params:
+                    tp: bool = False, mics: bool = False) -> Params:
     """PartitionSpec pytree matching :func:`init_params`.
 
     TP (reference module_inject/auto_tp.py row/col slicing): qkv + mlp-in are
@@ -564,7 +564,14 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
     over ('data','expert') so FSDP and TP compose. Stages 0-2 leave params
     replicated (grads/opt-state sharding is handled by the engine).
     """
-    fsdp = ("data", "expert") if zero_stage >= 3 else None
+    # MiCS (reference runtime/zero/mics.py:63): param shards live within
+    # the (data_inner, expert) sub-group and replicate across 'data', so
+    # stage-3 allgathers stay inside the cheap sub-group links
+    if zero_stage >= 3:
+        fsdp = ("data_inner", "expert") if mics else \
+            ("data", "data_inner", "expert")
+    else:
+        fsdp = None
     model = "model" if tp else None
 
     def spec(*axes):
@@ -591,9 +598,12 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
 
     if cfg.num_experts:
         # expert weights: E dim sharded over 'expert'; FSDP restricted to
-        # 'data' so the axes don't collide (reference: expert params are DP'd
-        # over the expert-data-parallel group only, groups.py:315)
-        efsdp = "data" if zero_stage >= 3 else None
+        # the data axes so they don't collide (reference: expert params are
+        # DP'd over the expert-data-parallel group only, groups.py:315)
+        if zero_stage >= 3:
+            efsdp = "data_inner" if mics else ("data", "data_inner")
+        else:
+            efsdp = None
         layers["moe"] = {
             "router": spec(None, fsdp, None),
             "wg": spec(None, "expert", efsdp, model),

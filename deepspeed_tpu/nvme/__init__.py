@@ -1,0 +1,5 @@
+"""NVMe perf tools (reference: deepspeed/nvme/ — ds_io / ds_nvme_tune)."""
+
+from deepspeed_tpu.nvme.perf import run_sweep, sweep_config_space
+
+__all__ = ["run_sweep", "sweep_config_space"]

@@ -1,0 +1,81 @@
+"""NVMe I/O benchmark sweep.
+
+Reference: ``deepspeed/nvme/perf_run_sweep.py`` + ``bin/ds_io`` /
+``bin/ds_nvme_tune`` — sweep (threads × block size × queue depth) over the
+aio engine, report read/write GB/s, recommend the best config for
+ZeRO-Infinity's swap path. Here the engine under test is the C++
+AsyncIOEngine (csrc/async_io.cpp) that runtime/zero/infinity.py uses, so
+the number this reports is exactly the bandwidth the optimizer sweep will
+see.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.io.async_io import AsyncIOEngine
+
+
+def _bench_one(path: str, total_mb: int, block_kb: int, threads: int,
+               read: bool) -> float:
+    """One (threads, block) point → GB/s."""
+    eng = AsyncIOEngine(num_threads=threads)
+    block = block_kb * 1024 // 4                  # fp32 elements
+    total = total_mb * 1024 * 1024 // 4
+    buf = np.random.default_rng(0).random(block).astype(np.float32)
+    if read:
+        # populate the file first
+        for off in range(0, total, block):
+            eng.pwrite(path, buf, off * 4)
+        eng.drain()
+    out = np.empty(block, np.float32)
+    t0 = time.perf_counter()
+    for off in range(0, total, block):
+        if read:
+            eng.pread(path, out, off * 4)
+        else:
+            eng.pwrite(path, buf, off * 4)
+    eng.drain()
+    dt = time.perf_counter() - t0
+    return (total * 4 / 1e9) / dt
+
+
+def sweep_config_space(threads: List[int] = (1, 2, 4, 8),
+                       block_kb: List[int] = (256, 1024, 4096)
+                       ) -> List[Dict]:
+    return [{"threads": t, "block_kb": b} for t in threads
+            for b in block_kb]
+
+
+def run_sweep(nvme_dir: str, total_mb: int = 64,
+              configs: Optional[List[Dict]] = None,
+              results_path: Optional[str] = None) -> Dict:
+    """Sweep read+write bandwidth; returns
+    {"results": [...], "best_read": cfg, "best_write": cfg}
+    (reference ds_nvme_tune output shape)."""
+    os.makedirs(nvme_dir, exist_ok=True)
+    path = os.path.join(nvme_dir, "ds_io_bench.bin")
+    configs = configs or sweep_config_space()
+    results = []
+    for cfg in configs:
+        wr = _bench_one(path, total_mb, cfg["block_kb"], cfg["threads"],
+                        read=False)
+        rd = _bench_one(path, total_mb, cfg["block_kb"], cfg["threads"],
+                        read=True)
+        results.append({**cfg, "write_gbps": wr, "read_gbps": rd})
+    out = {
+        "results": results,
+        "best_read": max(results, key=lambda r: r["read_gbps"]),
+        "best_write": max(results, key=lambda r: r["write_gbps"]),
+    }
+    if results_path:
+        with open(results_path, "w") as fh:
+            json.dump(out, fh, indent=1)
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return out

@@ -23,33 +23,41 @@ class HostAdam:
     def __init__(self, num_elements: int, lr: float = 1e-3,
                  beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
-                 use_native: Optional[bool] = None):
+                 use_native: Optional[bool] = None,
+                 allocate_state: bool = True):
+        """``allocate_state=False`` skips the moment buffers — for callers
+        that keep moments elsewhere (the NVMe windowed sweep) and drive
+        :meth:`step_buffers` directly; :meth:`step` then raises."""
         self.n = int(num_elements)
         self.lr = lr
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
         self.weight_decay = weight_decay
         self.adamw_mode = adamw_mode
         self.step_count = 0
-        self.exp_avg = np.zeros(self.n, np.float32)
-        self.exp_avg_sq = np.zeros(self.n, np.float32)
+        self.exp_avg = np.zeros(self.n, np.float32) if allocate_state else None
+        self.exp_avg_sq = (np.zeros(self.n, np.float32) if allocate_state
+                           else None)
         if use_native is None:
             use_native = is_native_available()
         self._lib = load_host_adam() if use_native else None
 
-    def step(self, params: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None) -> None:
-        """In-place update of ``params`` (flat fp32, C-contiguous)."""
+    def step_buffers(self, params: np.ndarray, grads: np.ndarray,
+                     exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+                     step: int, lr: float) -> None:
+        """One fused Adam sweep over caller-provided flat fp32 buffers with
+        an explicit global step (so windowed callers share one bias
+        correction). The single home of the Adam math — native and numpy
+        paths both live here."""
+        n = params.size
         assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
-        assert params.size == self.n == grads.size
-        self.step_count += 1
-        lr = self.lr if lr is None else float(lr)
+        assert grads.size == exp_avg.size == exp_avg_sq.size == n
         if grads.dtype != np.float32:
             grads = grads.astype(np.float32)
         grads = np.ascontiguousarray(grads)
         if self._lib is not None:
             self._lib.ds_host_adam_step(
-                _f32p(params), _f32p(grads), _f32p(self.exp_avg),
-                _f32p(self.exp_avg_sq), self.n, self.step_count, lr,
+                _f32p(params), _f32p(grads), _f32p(exp_avg),
+                _f32p(exp_avg_sq), n, step, lr,
                 self.beta1, self.beta2, self.eps, self.weight_decay,
                 1 if self.adamw_mode else 0)
             return
@@ -57,17 +65,28 @@ class HostAdam:
         g = grads
         if not self.adamw_mode and self.weight_decay:
             g = g + self.weight_decay * params
-        self.exp_avg *= self.beta1
-        self.exp_avg += (1 - self.beta1) * g
-        self.exp_avg_sq *= self.beta2
-        self.exp_avg_sq += (1 - self.beta2) * g * g
-        bc1 = 1 - self.beta1 ** self.step_count
-        bc2 = 1 - self.beta2 ** self.step_count
-        update = (self.exp_avg / bc1) / (np.sqrt(self.exp_avg_sq / bc2)
-                                         + self.eps)
+        exp_avg *= self.beta1
+        exp_avg += (1 - self.beta1) * g
+        exp_avg_sq *= self.beta2
+        exp_avg_sq += (1 - self.beta2) * g * g
+        bc1 = 1 - self.beta1 ** step
+        bc2 = 1 - self.beta2 ** step
+        update = (exp_avg / bc1) / (np.sqrt(exp_avg_sq / bc2) + self.eps)
         if self.adamw_mode and self.weight_decay:
             update = update + self.weight_decay * params
         params -= lr * update
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        """In-place update of ``params`` (flat fp32, C-contiguous)."""
+        if self.exp_avg is None:
+            raise RuntimeError("HostAdam built with allocate_state=False "
+                               "has no moment buffers; use step_buffers")
+        assert params.size == self.n == grads.size
+        self.step_count += 1
+        self.step_buffers(params, grads, self.exp_avg, self.exp_avg_sq,
+                          self.step_count, self.lr if lr is None
+                          else float(lr))
 
     def grad_norm(self, grads: np.ndarray) -> float:
         if self._lib is not None and grads.dtype == np.float32 and \
@@ -75,3 +94,72 @@ class HostAdam:
             return float(np.sqrt(
                 self._lib.ds_l2_norm_sq(_f32p(grads), grads.size)))
         return float(np.linalg.norm(grads.astype(np.float64)))
+
+
+class HostAdagrad:
+    """Fused host Adagrad over one flat fp32 buffer (reference
+    ``csrc/adagrad/cpu_adagrad.cpp`` / ``ops/adagrad/cpu_adagrad.py``)."""
+
+    def __init__(self, num_elements: int, lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0,
+                 use_native: Optional[bool] = None):
+        self.n = int(num_elements)
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.step_count = 0
+        self.exp_avg_sq = np.zeros(self.n, np.float32)
+        if use_native is None:
+            use_native = is_native_available()
+        self._lib = load_host_adam() if use_native else None
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        assert params.size == self.n == grads.size
+        self.step_count += 1
+        lr = self.lr if lr is None else float(lr)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._lib is not None:
+            self._lib.ds_host_adagrad_step(
+                _f32p(params), _f32p(grads), _f32p(self.exp_avg_sq),
+                self.n, lr, self.eps, self.weight_decay)
+            return
+        g = grads + self.weight_decay * params if self.weight_decay else \
+            grads
+        self.exp_avg_sq += g * g
+        params -= lr * g / (np.sqrt(self.exp_avg_sq) + self.eps)
+
+
+class HostLion:
+    """Fused host Lion over one flat fp32 buffer (reference
+    ``csrc/lion/cpu_lion_impl.cpp`` / ``ops/lion/cpu_lion.py``)."""
+
+    def __init__(self, num_elements: int, lr: float = 1e-4,
+                 beta1: float = 0.9, beta2: float = 0.99,
+                 weight_decay: float = 0.0,
+                 use_native: Optional[bool] = None):
+        self.n = int(num_elements)
+        self.lr = lr
+        self.beta1, self.beta2 = beta1, beta2
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self.exp_avg = np.zeros(self.n, np.float32)
+        if use_native is None:
+            use_native = is_native_available()
+        self._lib = load_host_adam() if use_native else None
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        assert params.size == self.n == grads.size
+        self.step_count += 1
+        lr = self.lr if lr is None else float(lr)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._lib is not None:
+            self._lib.ds_host_lion_step(
+                _f32p(params), _f32p(grads), _f32p(self.exp_avg),
+                self.n, lr, self.beta1, self.beta2, self.weight_decay)
+            return
+        c = self.beta1 * self.exp_avg + (1 - self.beta1) * grads
+        params -= lr * (np.sign(c) + self.weight_decay * params)
+        self.exp_avg *= self.beta2
+        self.exp_avg += (1 - self.beta2) * grads

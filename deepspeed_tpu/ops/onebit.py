@@ -1,0 +1,191 @@
+"""1-bit Adam — communication-compressed optimizer family.
+
+Reference: ``runtime/fp16/onebit/adam.py:14`` (OnebitAdam), ``zoadam.py``
+(0/1 Adam), over the compressed backends (runtime/comm/nccl.py:52). The
+algorithm: a **warmup** phase (``freeze_step`` steps) runs exact Adam with
+full-precision gradient averaging while the variance estimate stabilizes;
+after the freeze the variance is FROZEN and each worker updates its
+momentum with its LOCAL gradient, then exchanges only the SIGN bits of the
+momentum through the error-feedback 1-bit allreduce
+(comm/compressed.py) — 32× less traffic per step, the blogs' up-to-26×
+comm reduction.
+
+TPU design: one explicit ``shard_map`` step over 'data' (quantized/
+compressed collectives can't be expressed as GSPMD annotations — same
+stance as runtime/zero/zeropp.py). Params and m/v stay REPLICATED (the
+reference requires ZeRO stage 0 with 1-bit optimizers too); the error-
+feedback buffers are per-device state carried as [world, ...] arrays
+sharded over 'data'. Restrictions (validated): zero stage 0, bf16/fp32,
+no offload/pipeline, no gradient clipping in the compressed phase (the
+exact global norm is never materialized — reference has the same
+limitation).
+"""
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.compressed import (compressed_allreduce,
+                                           init_error_buffers, padded_size)
+from deepspeed_tpu.runtime.zero.offload import FlatLayout
+from deepspeed_tpu.utils.logging import log_dist
+
+ONEBIT_NAMES = ("onebitadam", "onebit_adam", "zerooneadam")
+
+
+def validate_onebit(engine) -> None:
+    cfg = engine.config
+    if cfg.zero_optimization.stage != 0:
+        raise ValueError("1-bit Adam requires ZeRO stage 0 (reference "
+                         "onebit/adam.py restriction: momentum comm "
+                         "replaces the grad allreduce)")
+    for ax in ("model", "seq", "pipe", "expert", "data_inner"):
+        if engine.mesh.shape[ax] != 1:
+            raise ValueError(f"1-bit Adam runs over the 'data' axis only; "
+                             f"mesh axis '{ax}' = {engine.mesh.shape[ax]}")
+    if engine.fp16_enabled:
+        raise ValueError("1-bit Adam here requires bf16/fp32 (fp16 "
+                         "overflow handling needs exact grads)")
+    if engine.offload_enabled:
+        raise ValueError("1-bit Adam and offload_optimizer are exclusive")
+    if engine.model.pipeline_loss_fn is not None:
+        raise ValueError("1-bit Adam does not compose with pipeline")
+
+
+def init_onebit_state(engine) -> None:
+    """Replicated flat master/m/v + per-device error-feedback buffers."""
+    mesh = engine.mesh
+    world = mesh.shape["data"]
+    layout = FlatLayout(engine._abstract_params)
+    engine._onebit_layout = layout
+    total = layout.total
+    padded = padded_size(total, world)
+    engine._onebit_padded = padded
+
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("data"))
+    flat_params = jax.jit(
+        lambda p: layout.flatten_device(p, jnp.float32),
+        out_shardings=rep)(engine.params)
+    engine.opt_state = {
+        "master": flat_params,
+        "m": jax.device_put(jnp.zeros((total,), jnp.float32), rep),
+        "v": jax.device_put(jnp.zeros((total,), jnp.float32), rep),
+        "werr": jax.device_put(jnp.zeros((world, padded), jnp.float32),
+                               dp),
+        "serr": jax.device_put(
+            jnp.zeros((world, padded // world), jnp.float32), dp),
+        "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+    }
+    engine._state_shardings = jax.tree.map(
+        lambda x: x.sharding, engine.opt_state)
+    log_dist(f"1-bit Adam: {total / 1e6:.1f}M params, dp={world}, "
+             f"compressed momentum after freeze_step")
+
+
+def build_onebit_step(engine) -> None:
+    cfg = engine.config
+    mesh = engine.mesh
+    world = mesh.shape["data"]
+    layout = engine._onebit_layout
+    total = layout.total
+    padded = engine._onebit_padded
+    compute_dtype = engine.compute_dtype
+    gas = int(cfg.gradient_accumulation_steps)
+    lr_schedule = engine.lr_schedule
+    loss_fn = engine.model.loss_fn
+
+    p = dict(cfg.optimizer.params or {})
+    betas = p.get("betas", (0.9, 0.999))
+    b1, b2 = float(betas[0]), float(betas[1])
+    eps = float(p.get("eps", 1e-8))
+    wd = float(p.get("weight_decay", 0.0))
+    freeze_step = int(p.get("freeze_step", 100))
+
+    def body(params, opt, batch, step, rng):
+        def micro(carry, mb):
+            acc, r = carry
+            r, sub = jax.random.split(r)
+
+            def lf(pp):
+                out = loss_fn(pp, mb, sub)
+                return out[0] if isinstance(out, tuple) else out
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            return (acc + layout.flatten_device(grads, jnp.float32), r), \
+                loss
+
+        acc0 = jnp.zeros((total,), jnp.float32)
+        (g_local, _), losses = lax.scan(micro, (acc0, rng), batch)
+        g_local = g_local * (1.0 / gas)
+
+        master, m, v = opt["master"], opt["m"], opt["v"]
+        werr, serr = opt["werr"][0], opt["serr"][0]
+        t_new = opt["step"] + 1
+
+        def warmup(_):
+            g = lax.pmean(g_local, "data")
+            m1 = b1 * m + (1 - b1) * g
+            v1 = b2 * v + (1 - b2) * g * g
+            return m1, v1, werr, serr
+
+        def compressed(_):
+            # local momentum then 1-bit error-feedback allreduce of it
+            ml = b1 * m + (1 - b1) * g_local
+            ml_pad = jnp.concatenate(
+                [ml, jnp.zeros((padded - total,), jnp.float32)])
+            m_avg, w2, s2 = compressed_allreduce(ml_pad, werr, serr,
+                                                 "data")
+            return m_avg[:total], v, w2, s2       # variance FROZEN
+
+        m1, v1, w2, s2 = lax.cond(t_new <= freeze_step, warmup,
+                                  compressed, None)
+        bc1 = 1 - b1 ** t_new.astype(jnp.float32)
+        bc2 = 1 - b2 ** jnp.minimum(
+            t_new, freeze_step).astype(jnp.float32)
+        lr = lr_schedule(step)
+        upd = (m1 / bc1) / (jnp.sqrt(v1 / bc2) + eps)
+        if wd:
+            upd = upd + wd * master
+        master1 = master - lr * upd
+        new_flat = master1.astype(compute_dtype)
+        loss = lax.pmean(jnp.mean(losses), "data")
+        mnorm = jnp.sqrt(jnp.sum(jnp.square(m1)))
+        new_opt = {"master": master1, "m": m1, "v": v1,
+                   "werr": w2[None], "serr": s2[None], "step": t_new}
+        return new_flat, new_opt, loss, mnorm, lr
+
+    param_specs = jax.tree.map(lambda _: P(), engine.params)
+    opt_specs = {"master": P(), "m": P(), "v": P(),
+                 "werr": P("data"), "serr": P("data"), "step": P()}
+
+    def fused_step(params, opt_state, scaler, batch, step, rng):
+        batch_specs = jax.tree.map(
+            lambda x: P(None, "data", *([None] * (np.ndim(x) - 2))),
+            batch)
+        new_flat, new_opt, loss, mnorm, lr = shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, opt_specs, batch_specs, P(), P()),
+            out_specs=(P(), opt_specs, P(), P(), P()),
+            check_vma=False,
+        )(params, opt_state, batch, step, rng)
+        new_params = layout.unflatten_device(
+            new_flat, [compute_dtype if jnp.issubdtype(d, jnp.floating)
+                       else d for d in layout.dtypes])
+        new_params = lax.with_sharding_constraint(
+            new_params, engine._param_shardings)
+        metrics = {"loss": loss, "lr": lr, "grad_norm": mnorm,
+                   "loss_scale": scaler.scale,
+                   "overflow": jnp.zeros((), jnp.int32)}
+        return new_params, new_opt, scaler, metrics
+
+    engine._fused_step = jax.jit(fused_step, donate_argnums=(0, 1))
+    engine._grad_step = None
+    engine._acc_add = None
+    engine._update_step = None
+    engine._rng = jax.random.PRNGKey(cfg.seed + 1)

@@ -55,7 +55,10 @@ class NativeOpBuilder:
             return out
         _CACHE.mkdir(parents=True, exist_ok=True)
         cxx = os.environ.get("CXX", "g++")
-        cmd = [cxx, *_CXX_FLAGS, "-o", str(out), *self.sources]
+        # compile to a process-unique temp path, then atomically rename:
+        # a concurrent process must never dlopen a half-written .so
+        tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+        cmd = [cxx, *_CXX_FLAGS, "-o", str(tmp), *self.sources]
         logger.info(f"building native op '{self.name}': {' '.join(cmd)}")
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -68,6 +71,7 @@ class NativeOpBuilder:
             except subprocess.CalledProcessError:
                 raise RuntimeError(
                     f"native build of {self.name} failed:\n{exc.stderr}")
+        os.replace(tmp, out)
         return out
 
     def load(self) -> ctypes.CDLL:
@@ -100,6 +104,14 @@ def load_host_adam() -> ctypes.CDLL:
     lib.ds_f32_to_bf16.argtypes = [ctypes.POINTER(ctypes.c_float),
                                    ctypes.POINTER(ctypes.c_uint16),
                                    ctypes.c_int64]
+    lib.ds_host_adagrad_step.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float]
+    lib.ds_host_lion_step.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float]
     return lib
 
 

@@ -240,8 +240,10 @@ def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
         .reshape(n, c, h, dh)
 
 
-def supported(chunk: int, groups: int, head_dim: int,
-              block_size: int) -> bool:
-    """Shape gate for the Pallas path (tile rule on the last two dims)."""
+def supported(head_dim: int, block_size: int) -> bool:
+    """Shape gate for the Pallas path: the KV block's last two dims
+    (block_size, head_dim) must satisfy the (8, 128) tile rule, and the
+    kernel only pays off on TPU. Query rows (groups × chunk) need no gate —
+    Pallas pads the sublane dim."""
     return head_dim % 128 == 0 and block_size % 8 == 0 and \
         jax.default_backend() == "tpu"

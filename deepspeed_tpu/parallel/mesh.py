@@ -22,13 +22,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
-#: canonical axis order, outermost first
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "seq", "model")
+#: canonical axis order, outermost first. 'data_inner' is the MiCS / hpZ
+#: sub-group axis (reference runtime/zero/mics.py:63): when its size > 1,
+#: ZeRO-3 param shards live WITHIN a (data_inner × expert) sub-group and
+#: replicate across 'data' — param allgathers ride the cheap inner links
+#: while gradients still reduce across the full DP product. Size 1 (the
+#: default) collapses it to plain ZeRO.
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "data_inner", "expert",
+                              "seq", "model")
 
-#: ZeRO shards over the data axis (and the expert axis for non-expert params,
-#: since dp_world = data × expert for those — reference groups.py expert-data
-#: parallel design)
-ZERO_AXES: Tuple[str, ...] = ("data", "expert")
+#: ZeRO shards over the full data-parallel product (reference groups.py
+#: expert-data parallel design)
+ZERO_AXES: Tuple[str, ...] = ("data", "data_inner", "expert")
+
+#: MiCS/hpZ sub-group axes — stage-3 param sharding when mics_shard_size>1
+MICS_AXES: Tuple[str, ...] = ("data_inner", "expert")
 
 _CURRENT_MESH: Optional[Mesh] = None
 
@@ -38,32 +46,38 @@ def build_mesh(data: Optional[int] = None,
                pipe: int = 1,
                seq: int = 1,
                expert: int = 1,
+               data_inner: int = 1,
                devices: Optional[Sequence[jax.Device]] = None,
                set_current: bool = True) -> Mesh:
     """Build the framework mesh.
 
     ``data=None`` infers the data-parallel degree from the device count
-    (reference analogue: world_size / (tp×pp×sp×ep)).
+    (reference analogue: world_size / (tp×pp×sp×ep)). ``data_inner`` is
+    the MiCS/hpZ sub-group size (divides the total DP degree).
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    fixed = model * pipe * seq * expert
+    fixed = model * pipe * seq * expert * data_inner
     if data is None:
         if n % fixed:
             raise ValueError(
-                f"device count {n} not divisible by model×pipe×seq×expert={fixed}")
+                f"device count {n} not divisible by "
+                f"model×pipe×seq×expert×data_inner={fixed}")
         data = n // fixed
     total = data * fixed
     if total != n:
         raise ValueError(
             f"mesh axes product {total} != device count {n} "
-            f"(pipe={pipe} data={data} expert={expert} seq={seq} model={model})")
-    arr = np.array(devices[:total]).reshape(pipe, data, expert, seq, model)
+            f"(pipe={pipe} data={data} data_inner={data_inner} "
+            f"expert={expert} seq={seq} model={model})")
+    arr = np.array(devices[:total]).reshape(pipe, data, data_inner,
+                                            expert, seq, model)
     mesh = Mesh(arr, MESH_AXES)
     if set_current:
         set_mesh(mesh)
-    log_dist(f"built mesh: pipe={pipe} data={data} expert={expert} "
+    log_dist(f"built mesh: pipe={pipe} data={data} "
+             f"data_inner={data_inner} expert={expert} "
              f"seq={seq} model={model}")
     return mesh
 
@@ -75,6 +89,8 @@ def mesh_from_config(config, devices=None) -> Mesh:
         pipe=config.pipeline.stages,
         seq=config.sequence_parallel.size,
         expert=config.moe.ep_size if config.moe.enabled else 1,
+        data_inner=max(int(config.zero_optimization.mics_shard_size or 1),
+                       1),
         devices=devices,
     )
 
@@ -106,10 +122,12 @@ def _axis_size(mesh: Optional[Mesh], axis: str) -> int:
 
 
 def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
-    """DP degree for non-expert params = data × expert (reference
-    groups.py:_get_data_parallel_world_size with expert interleaving)."""
+    """DP degree for non-expert params = data × data_inner × expert
+    (reference groups.py:_get_data_parallel_world_size with expert
+    interleaving)."""
     mesh = mesh or get_mesh()
-    return mesh.shape["data"] * mesh.shape["expert"]
+    return mesh.shape["data"] * mesh.shape["data_inner"] * \
+        mesh.shape["expert"]
 
 
 def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:

@@ -1,0 +1,86 @@
+"""Memory-mapped indexed token dataset.
+
+Reference: ``runtime/data_pipeline/data_sampling/indexed_dataset.py`` (the
+Megatron-style ``.bin`` token stream + ``.idx`` offsets format,
+``MMapIndexedDataset``/``MMapIndexedDatasetBuilder``). Same two-file
+design, simplified header; documents are variable-length int token
+sequences, reads are zero-copy ``np.memmap`` slices — the right host-side
+layout for feeding a TPU input pipeline (no per-item pickling).
+
+Format::
+
+    <stem>.bin   raw little-endian tokens, all docs concatenated
+    <stem>.idx   magic | version | dtype_code | n_docs | u64 offsets[n+1]
+"""
+
+import os
+import struct
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint16, 2: np.int32, 3: np.int64, 4: np.uint8}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class IndexedDatasetBuilder:
+    """Streaming writer (reference MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, stem: str, dtype=np.int32):
+        self.stem = stem
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(stem + ".bin", "wb")
+        self._offsets: List[int] = [0]
+
+    def add_doc(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, self.dtype)
+        self._bin.write(arr.tobytes())
+        self._offsets.append(self._offsets[-1] + arr.size)
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(self.stem + ".idx", "wb") as fh:
+            fh.write(_MAGIC)
+            fh.write(struct.pack("<HHQ", _VERSION,
+                                 _DTYPE_CODES[self.dtype],
+                                 len(self._offsets) - 1))
+            fh.write(np.asarray(self._offsets, np.uint64).tobytes())
+
+
+class IndexedDataset:
+    """Zero-copy reader (reference MMapIndexedDataset)."""
+
+    def __init__(self, stem: str):
+        with open(stem + ".idx", "rb") as fh:
+            magic = fh.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{stem}.idx: bad magic {magic!r}")
+            version, code, n = struct.unpack("<HHQ", fh.read(12))
+            if version != _VERSION:
+                raise ValueError(f"unsupported version {version}")
+            self.dtype = np.dtype(_DTYPES[code])
+            self.offsets = np.frombuffer(fh.read(8 * (n + 1)), np.uint64)
+        self.data = np.memmap(stem + ".bin", dtype=self.dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        a, b = int(self.offsets[i]), int(self.offsets[i + 1])
+        return self.data[a:b]
+
+    def doc_lengths(self) -> np.ndarray:
+        return (self.offsets[1:] - self.offsets[:-1]).astype(np.int64)
+
+
+def build_indexed_dataset(stem: str, docs: Iterable[Sequence[int]],
+                          dtype=np.int32) -> IndexedDataset:
+    b = IndexedDatasetBuilder(stem, dtype)
+    for d in docs:
+        b.add_doc(d)
+    b.finalize()
+    return IndexedDataset(stem)

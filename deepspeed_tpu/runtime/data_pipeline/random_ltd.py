@@ -1,0 +1,68 @@
+"""Random-LTD — random layerwise token dropping.
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py``
+(RandomLayerTokenDrop) + ``scheduler.py`` (token-keep schedule) +
+``csrc/random_ltd/`` (token_sort / gather_scatter CUDA kernels). The
+method: during training, middle layers process only a random SUBSET of
+tokens; dropped tokens skip the layer (residual identity) and rejoin
+afterwards — big FLOP savings early in training with a schedule ramping
+back to full sequence.
+
+TPU design: the CUDA gather/scatter kernels become ``jnp.take`` /
+scatter-add, which XLA lowers to efficient dynamic-gather; the kept-token
+count is a HOST-side schedule value (static per compiled step, like the
+reference's per-interval update — retrace happens only when the schedule
+moves, every ``schedule_period`` steps).
+"""
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Linear token-keep schedule (reference data_routing/scheduler.py):
+    from ``start_tokens`` kept per sequence up to the full ``max_tokens``
+    over ``schedule_period``-step increments of ``schedule_step``."""
+
+    def __init__(self, start_tokens: int, max_tokens: int,
+                 schedule_step: int, schedule_period: int):
+        self.start_tokens = int(start_tokens)
+        self.max_tokens = int(max_tokens)
+        self.schedule_step = int(schedule_step)
+        self.schedule_period = max(int(schedule_period), 1)
+
+    def keep_count(self, global_step: int) -> int:
+        inc = (global_step // self.schedule_period) * self.schedule_step
+        return int(min(self.start_tokens + inc, self.max_tokens))
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"start_tokens": self.start_tokens,
+                "max_tokens": self.max_tokens}
+
+
+def random_ltd_indices(rng: jax.Array, batch: int, seq: int, keep: int
+                       ) -> jax.Array:
+    """[B, keep] sorted kept-token indices, independent per row
+    (reference token_sort_ kernel: random perm then sort the kept
+    prefix — order is preserved so attention stays causal)."""
+    noise = jax.random.uniform(rng, (batch, seq))
+    picked = jnp.argsort(noise, axis=1)[:, :keep]
+    return jnp.sort(picked, axis=1)
+
+
+def random_ltd_layer(layer_fn: Callable[[jax.Array], jax.Array],
+                     x: jax.Array, rng: jax.Array, keep: int
+                     ) -> jax.Array:
+    """Apply ``layer_fn`` to a random kept subset of tokens; dropped
+    tokens pass through untouched (reference RandomLayerTokenDrop.forward
+    gather → layer → scatter)."""
+    b, t, d = x.shape
+    if keep >= t:
+        return layer_fn(x)
+    idx = random_ltd_indices(rng, b, t, keep)            # [B, K]
+    gathered = jnp.take_along_axis(x, idx[..., None], axis=1)  # [B, K, D]
+    out = layer_fn(gathered)
+    # scatter back over the kept positions; dropped rows keep x (identity)
+    return x.at[jnp.arange(b)[:, None], idx].set(out.astype(x.dtype))

@@ -1,0 +1,102 @@
+"""Power-iteration Hessian eigenvalue estimation.
+
+Reference: ``runtime/eigenvalue.py:13`` (``Eigenvalue.compute_eigenvalue``
+— per-block power iteration over autograd with retain_graph, used to
+drive the quantization schedule in MoQ). The torch version hand-rolls
+Hv products by re-differentiating; on jax an HVP is one ``jax.jvp``
+over ``jax.grad`` — forward-over-reverse, one compile, no graph
+retention.
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _hvp(loss_fn: Callable[[Pytree], jax.Array], params: Pytree,
+         v: Pytree) -> Pytree:
+    """Hessian-vector product: H(params) @ v (forward-over-reverse)."""
+    return jax.jvp(jax.grad(loss_fn), (params,), (v,))[1]
+
+
+def _tree_norm(t: Pytree) -> jax.Array:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in jax.tree.leaves(t)))
+
+
+def _tree_dot(a: Pytree, b: Pytree) -> jax.Array:
+    return sum(jnp.sum(x.astype(jnp.float32) * y.astype(jnp.float32))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def power_iteration(loss_fn: Callable[[Pytree], jax.Array],
+                    params: Pytree, rng: jax.Array,
+                    max_iter: int = 100, tol: float = 1e-2,
+                    stability: float = 1e-6) -> Tuple[jax.Array, Pytree]:
+    """Dominant |eigenvalue| of the loss Hessian at ``params`` (reference
+    compute_eigenvalue's max_iter/tol/stability semantics). Returns
+    (eigenvalue, eigenvector pytree)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    v = jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(k, x.shape, jnp.float32)
+                  for k, x in zip(keys, leaves)])
+    norm = _tree_norm(v)
+    v = jax.tree.map(lambda x: x / (norm + stability), v)
+
+    def body(carry):
+        v, prev_ev, i, _ = carry
+        hv = _hvp(loss_fn, params, v)
+        ev = _tree_dot(v, hv)
+        n = _tree_norm(hv)
+        v_new = jax.tree.map(lambda x: x / (n + stability), hv)
+        converged = jnp.abs(ev - prev_ev) / (jnp.abs(ev) + stability) < tol
+        return v_new, ev, i + 1, converged
+
+    def cond(carry):
+        _, _, i, converged = carry
+        return jnp.logical_and(i < max_iter, jnp.logical_not(converged))
+
+    v, ev, _, _ = jax.lax.while_loop(
+        cond, body, (v, jnp.float32(0.0), jnp.int32(0), jnp.bool_(False)))
+    return jnp.abs(ev), v
+
+
+class Eigenvalue:
+    """Per-layer eigenvalue sweep (reference Eigenvalue class): computes
+    the dominant Hessian eigenvalue restricted to each selected subtree —
+    the per-layer sensitivity signal MoQ's quantization scheduler
+    consumes."""
+
+    def __init__(self, max_iter: int = 100, tol: float = 1e-2,
+                 stability: float = 1e-6):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+
+    def compute_eigenvalue(self, loss_fn: Callable[[Pytree], jax.Array],
+                           params: Pytree, rng: jax.Array,
+                           layer_keys: Optional[Tuple[str, ...]] = None
+                           ) -> Dict[str, float]:
+        """layer_keys: top-level keys of ``params`` to analyze (default:
+        all). The Hessian block is taken w.r.t. that subtree with the rest
+        frozen."""
+        keys = layer_keys or tuple(params.keys())
+        out: Dict[str, float] = {}
+        for i, key in enumerate(keys):
+            sub = params[key]
+
+            def block_loss(subtree, key=key):
+                merged = dict(params)
+                merged[key] = subtree
+                return loss_fn(merged)
+
+            ev, _ = power_iteration(block_loss, sub,
+                                    jax.random.fold_in(rng, i),
+                                    self.max_iter, self.tol,
+                                    self.stability)
+            out[key] = float(ev)
+        return out

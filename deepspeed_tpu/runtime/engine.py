@@ -73,6 +73,22 @@ class ModelSpec:
     #: train_batch:337 — forward()/backward() are not supported, matching
     #: the reference's restriction)
     pipeline_loss_fn: Optional[Callable[[Pytree, Batch, jax.Array], Any]] = None
+    #: 1F1B path: (params, batch, rng, scale) -> (loss, grads) — explicit
+    #: per-microbatch backward (runtime/pipe 1F1B schedule); preferred over
+    #: pipeline_loss_fn's autodiff GPipe when set
+    pipeline_grad_fn: Optional[Callable[..., Any]] = None
+    #: the DecoderConfig this spec was built from (set by model_factory);
+    #: lets the hybrid engine spin up an inference engine over the same
+    #: params (reference runtime/hybrid_engine.py)
+    decoder_config: Optional[Any] = None
+
+
+@dataclass
+class _ParkedShards:
+    """Host copy of a multi-host array's LOCAL shards (offload_states)."""
+    shape: Tuple[int, ...]
+    dtype: Any
+    shards: Dict[Any, np.ndarray]
 
 
 class DeepSpeedTPUEngine:
@@ -112,8 +128,20 @@ class DeepSpeedTPUEngine:
             in ("cpu", "nvme"))
         self.offload_overlap = False
         self._host_future = None
-        self.optimizer, base_lr = build_optimizer(
-            config.optimizer.type, config.optimizer.params)
+        from deepspeed_tpu.ops.onebit import ONEBIT_NAMES
+        self._onebit_enabled = config.optimizer.type.lower() \
+            .replace("-", "").replace("_", "") in \
+            tuple(n.replace("_", "") for n in ONEBIT_NAMES)
+        if self._onebit_enabled:
+            # the Optimizer object only contributes base_lr/hyperparams;
+            # the 1-bit step path (ops/onebit.py) owns the update
+            opt_params = {k: v for k, v in
+                          (config.optimizer.params or {}).items()
+                          if k != "freeze_step"}
+            self.optimizer, base_lr = build_optimizer("adamw", opt_params)
+        else:
+            self.optimizer, base_lr = build_optimizer(
+                config.optimizer.type, config.optimizer.params)
         self.lr_schedule: Schedule = build_schedule(
             config.scheduler.type, config.scheduler.params, base_lr)
 
@@ -180,6 +208,22 @@ class DeepSpeedTPUEngine:
         base_specs = self._base_specs()
         self.plan = ZeroShardingPlan(self.mesh, self.zero_stage, base_specs,
                                      self._abstract_params)
+        zcfg = self.config.zero_optimization
+        self._zeropp_enabled = bool(zcfg.zero_quantized_weights or
+                                    zcfg.zero_quantized_gradients)
+        if self._zeropp_enabled:
+            # ZeRO++ swaps in flat sharded storage + explicit quantized
+            # collectives (runtime/zero/zeropp.py)
+            from deepspeed_tpu.runtime.zero.zeropp import (init_zeropp_state,
+                                                           validate_zeropp)
+            validate_zeropp(self)
+            init_zeropp_state(self, params, rng)
+            return
+        if self._onebit_enabled:
+            # validate HERE so an offload/pipeline config errors instead
+            # of silently taking the offload init path below
+            from deepspeed_tpu.ops.onebit import validate_onebit
+            validate_onebit(self)
         param_sh = self.plan.param_shardings()
         if params is None:
             init_jit = jax.jit(cast_init, out_shardings=param_sh)
@@ -216,6 +260,12 @@ class DeepSpeedTPUEngine:
             self._state_shardings = {}
             return
         self.host_optimizer = None
+        if self._onebit_enabled:
+            from deepspeed_tpu.ops.onebit import (init_onebit_state,
+                                                  validate_onebit)
+            validate_onebit(self)
+            init_onebit_state(self)
+            return
         abstract_state = jax.eval_shape(self.optimizer.init, self.params)
         state_sh = self.plan.opt_state_shardings(abstract_state)
         self.opt_state = jax.jit(self.optimizer.init,
@@ -305,6 +355,16 @@ class DeepSpeedTPUEngine:
     def _build_step_functions(self) -> None:
         gas = int(self.config.gradient_accumulation_steps)
 
+        if getattr(self, "_zeropp_enabled", False):
+            from deepspeed_tpu.runtime.zero.zeropp import build_zeropp_step
+            build_zeropp_step(self)
+            return
+
+        if getattr(self, "_onebit_enabled", False):
+            from deepspeed_tpu.ops.onebit import build_onebit_step
+            build_onebit_step(self)
+            return
+
         if self.offload_enabled:
             if self.model.pipeline_loss_fn is not None:
                 raise ValueError(
@@ -357,12 +417,18 @@ class DeepSpeedTPUEngine:
 
         if self.model.pipeline_loss_fn is not None:
             # pipeline path: the schedule consumes all M microbatches in
-            # one traced program; loss is already the mean over them
+            # one traced program; loss is already the mean over them.
+            # 1F1B (pipeline_grad_fn) computes grads explicitly per
+            # microbatch; GPipe (pipeline_loss_fn) goes through autodiff.
             def pipe_step(params, opt_state, scaler, batch, step, rng):
-                def scaled(p):
-                    loss = self.model.pipeline_loss_fn(p, batch, rng)
-                    return loss * scaler.scale, loss
-                grads, loss = jax.grad(scaled, has_aux=True)(params)
+                if self.model.pipeline_grad_fn is not None:
+                    loss, grads = self.model.pipeline_grad_fn(
+                        params, batch, rng, scaler.scale)
+                else:
+                    def scaled(p):
+                        loss = self.model.pipeline_loss_fn(p, batch, rng)
+                        return loss * scaler.scale, loss
+                    grads, loss = jax.grad(scaled, has_aux=True)(params)
                 grads = jax.lax.with_sharding_constraint(
                     grads, self.plan.grad_shardings())
                 params, opt_state, scaler, metrics = self._apply_update(
@@ -441,8 +507,8 @@ class DeepSpeedTPUEngine:
         if self._grad_step is None:
             raise RuntimeError(
                 "forward()/backward()/step() are not supported with "
-                "pipeline parallelism; use train_batch() "
-                "(reference pipe/engine.py restriction)")
+                "pipeline parallelism or the ZeRO++ quantized path; use "
+                "train_batch() (reference pipe/engine.py restriction)")
         self._rng, sub = jax.random.split(self._rng)
         batch = self._place_batch(batch)
         loss, grads = self._grad_step(self.params, batch,
@@ -676,6 +742,66 @@ class DeepSpeedTPUEngine:
 
     def loss_scale(self) -> float:
         return float(jax.device_get(self.loss_scale_state.scale))
+
+    # ------------------------------------------------- offload/reload states
+
+    def offload_states(self, include: Optional[Tuple[str, ...]] = None
+                       ) -> None:
+        """Move params/optimizer state to host DRAM and FREE the device
+        buffers (reference runtime/zero/offload_states.py:90 +
+        engine.offload_states — used to park a training engine while an
+        inference engine owns HBM, e.g. RLHF generation phases)."""
+        include = tuple(include or ("params", "opt_state"))
+        if getattr(self, "_offloaded_states", None):
+            raise RuntimeError("states already offloaded; reload first")
+        def to_host(x):
+            if not isinstance(x, jax.Array):
+                return np.asarray(x)
+            if x.is_fully_addressable:
+                return np.asarray(jax.device_get(x))
+            # multi-host sharded array: park only THIS process's shards
+            # (device_get on the global array would raise); reload
+            # reassembles via make_array_from_callback
+            return _ParkedShards(
+                shape=x.shape, dtype=x.dtype,
+                shards={s.index: np.asarray(s.data)
+                        for s in x.addressable_shards})
+
+        parked: Dict[str, Any] = {}
+        for name in include:
+            tree = getattr(self, name)
+            # `tree` may be a dict pytree OR one flat jax.Array (ZeRO++)
+            if tree is None or (isinstance(tree, dict) and not tree):
+                continue
+            host = jax.tree.map(to_host, tree)
+            for leaf in jax.tree.leaves(tree):
+                if isinstance(leaf, jax.Array):
+                    leaf.delete()          # actually release HBM
+            parked[name] = host
+            setattr(self, name, None)
+        self._offloaded_states = parked
+
+    def reload_states(self) -> None:
+        """Restore offloaded states to device with their original
+        shardings (reference engine.reload_states)."""
+        parked = getattr(self, "_offloaded_states", None)
+        if not parked:
+            return
+        shardings = {"params": self._param_shardings,
+                     "opt_state": self._state_shardings}
+
+        def restore(host, sh):
+            if isinstance(host, _ParkedShards):
+                return jax.make_array_from_callback(
+                    host.shape, sh, lambda idx: host.shards[idx])
+            return jax.device_put(host, sh)
+
+        for name, host in parked.items():
+            sh_tree = shardings[name]
+            setattr(self, name, jax.tree.map(
+                restore, host, sh_tree,
+                is_leaf=lambda x: isinstance(x, _ParkedShards)))
+        self._offloaded_states = None
 
     # --------------------------------------------------------- checkpointing
 

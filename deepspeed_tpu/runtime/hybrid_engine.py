@@ -1,0 +1,107 @@
+"""Hybrid engine — one model flipped between training and fast inference.
+
+Reference: ``runtime/hybrid_engine.py:30`` (``DeepSpeedHybridEngine``:
+RLHF actor that trains with ZeRO and generates with the inference
+kernels; ``generate``:168, LoRA fuse/unfuse:132–146, Z3 gather before
+generation). The torch version must gather ZeRO-3 shards and swap module
+implementations; on TPU the flip is cheap by construction:
+
+- params are an immutable pytree — the inference engine REFERENCES the
+  training engine's arrays (no copy, no gather: the inference forward's
+  own sharding constraints make XLA insert whatever resharding the
+  serving layout needs);
+- "kernel injection" is just jit of the cached-decode forward;
+- after each training step the next ``generate`` picks up the new params
+  by version tracking (the reference re-populates its containers the
+  same way).
+
+Offloaded/ZeRO++ storages are unflattened on demand. LoRA fuse/unfuse is
+exposed for OptimizedLinear-bearing pytrees via
+:func:`deepspeed_tpu.linear.merge_lora`.
+"""
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.inference.engine import (DeepSpeedTPUInferenceConfig,
+                                            InferenceEngineTPU)
+from deepspeed_tpu.utils.logging import log_dist
+
+Pytree = Any
+
+
+class DeepSpeedTPUHybridEngine:
+    """Wrap a training engine with a `generate()` that always serves the
+    CURRENT weights (reference DeepSpeedHybridEngine)."""
+
+    def __init__(self, engine,
+                 inference_config: Union[Dict[str, Any],
+                                         DeepSpeedTPUInferenceConfig,
+                                         None] = None):
+        if engine.model.decoder_config is None:
+            raise ValueError(
+                "hybrid engine needs a ModelSpec built from a "
+                "DecoderConfig (model_factory.decoder_model_spec)")
+        self.engine = engine
+        self.inference_config = inference_config or {"dtype": "bfloat16"
+                                                     if engine.bf16_enabled
+                                                     else "float32"}
+        self._inf: Optional[InferenceEngineTPU] = None
+        self._served_version = -1
+        self._version = 0
+        # count training steps to know when weights moved
+        self._last_global_steps = engine.global_steps
+        log_dist("hybrid engine ready: train<->infer flip over shared "
+                 "params")
+
+    # -- training passthroughs ---------------------------------------------
+
+    def train_batch(self, *a, **kw):
+        out = self.engine.train_batch(*a, **kw)
+        self._version += 1
+        return out
+
+    def __getattr__(self, name):
+        # delegate everything else (save_checkpoint, step counters, ...)
+        return getattr(self.engine, name)
+
+    # -- the flip -----------------------------------------------------------
+
+    def _current_params(self) -> Pytree:
+        eng = self.engine
+        if getattr(eng, "_zeropp_enabled", False):
+            from deepspeed_tpu.runtime.zero.zeropp import unflatten_params
+            return unflatten_params(eng)
+        if eng.offload_enabled:
+            eng._drain_host_step()      # overlapped update must land
+        return eng.params
+
+    def refresh_inference_engine(self) -> None:
+        """Rebuild/repoint the serving engine at the latest weights
+        (reference: _restore_transformer_layer / populate containers)."""
+        params = self._current_params()
+        if self._inf is None:
+            self._inf = InferenceEngineTPU(
+                self.engine.model.decoder_config, self.inference_config,
+                params=params, mesh=self.engine.mesh)
+        else:
+            import jax.numpy as jnp
+            cast = jax.tree.map(
+                lambda x: x.astype(self._inf.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+            self._inf.params = jax.device_put(cast, self._inf._param_sh)
+        self._served_version = self._version
+
+    def generate(self, input_ids, **kw) -> np.ndarray:
+        """Reference hybrid_engine.py:168 — serve the current weights."""
+        if self._inf is None or self._served_version != self._version:
+            self.refresh_inference_engine()
+        return self._inf.generate(input_ids, **kw)
+
+    def eval(self) -> None:     # parity no-ops (functional engine has no
+        pass                    # module train/eval mode)
+
+    def train(self) -> None:
+        pass

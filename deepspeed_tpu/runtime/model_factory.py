@@ -99,14 +99,18 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         return loss + aux if moe_fn is not None else loss
 
     tp = ds_cfg.tensor_parallel.enabled
+    mics = int(ds_cfg.zero_optimization.mics_shard_size or 0) > 1
     specs = transformer.partition_specs(
-        dec_cfg, zero_stage=ds_cfg.zero_optimization.stage, tp=tp)
+        dec_cfg, zero_stage=ds_cfg.zero_optimization.stage, tp=tp,
+        mics=mics)
 
     pipeline_loss_fn = None
+    pipeline_grad_fn = None
     stages = ds_cfg.pipeline.stages
     if stages > 1:
         from deepspeed_tpu.runtime.pipe.pipeline import (
-            pipeline_partition_specs, pipelined_loss)
+            pipeline_partition_specs, pipelined_loss,
+            pipelined_loss_and_grads_1f1b)
         assert dec_cfg.num_layers % stages == 0, (
             f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
             f"stages {stages}")
@@ -119,22 +123,38 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         pipe_attn = dot_product_attention \
             if attn_fn is flash_attention_sharded else attn_fn
 
+        def _pipe_labels(tokens, batch):
+            if "labels" in batch:
+                return batch["labels"]
+            return jnp.concatenate(
+                [tokens[:, :, 1:],
+                 jnp.full_like(tokens[:, :, :1], -100)], axis=2)
+
         def pipeline_loss_fn(params, batch, rng):
             tokens = batch["input_ids"]            # [M, B, T]
-            if "labels" in batch:
-                labels = batch["labels"]
-            else:
-                labels = jnp.concatenate(
-                    [tokens[:, :, 1:],
-                     jnp.full_like(tokens[:, :, :1], -100)], axis=2)
-            return pipelined_loss(dec_cfg, params, tokens, labels,
+            return pipelined_loss(dec_cfg, params, tokens,
+                                  _pipe_labels(tokens, batch),
                                   attn_fn=pipe_attn, moe_fn=moe_fn,
                                   remat_policy=remat or "full",
                                   num_stages=stages)
+
+        if ds_cfg.pipeline.schedule == "1f1b":
+            def pipeline_grad_fn(params, batch, rng, scale):
+                tokens = batch["input_ids"]        # [M, B, T]
+                return pipelined_loss_and_grads_1f1b(
+                    dec_cfg, params, tokens, _pipe_labels(tokens, batch),
+                    scale=scale, attn_fn=pipe_attn, moe_fn=moe_fn,
+                    remat_policy=remat or "full", num_stages=stages)
+        elif ds_cfg.pipeline.schedule != "gpipe":
+            raise ValueError(
+                f"pipeline.schedule must be '1f1b' or 'gpipe', got "
+                f"'{ds_cfg.pipeline.schedule}'")
 
     n = dec_cfg.num_params()
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn,
                      partition_specs=specs,
                      flops_per_token=6.0 * n,
                      tokens_per_sample=dec_cfg.max_seq_len,
-                     pipeline_loss_fn=pipeline_loss_fn)
+                     pipeline_loss_fn=pipeline_loss_fn,
+                     pipeline_grad_fn=pipeline_grad_fn,
+                     decoder_config=dec_cfg)

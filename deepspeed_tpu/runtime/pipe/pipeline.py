@@ -166,3 +166,222 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
                        out_specs=P(), axis_names={"pipe"})
     return fn(params["layers"], params["embed"], params["final_norm"],
               head, tokens, labels)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (reference runtime/pipe/schedule.py:189 TrainSchedule)
+# ---------------------------------------------------------------------------
+
+def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
+                                  labels, scale=1.0, attn_fn=None,
+                                  moe_fn=None,
+                                  remat_policy: Optional[str] = None,
+                                  mesh=None,
+                                  num_stages: Optional[int] = None):
+    """One-forward-one-backward pipeline step → (loss, grads).
+
+    Reference ``schedule.py:189`` (TrainSchedule): each tick a stage runs
+    one microbatch forward AND one backward, so only the in-flight
+    activations are stashed — activation memory is O(S), independent of
+    the microbatch count M (GPipe's autodiff path above holds all M).
+
+    Mechanics: backward is EXPLICIT per-microbatch ``jax.vjp`` with a
+    recompute-from-stash design — the stash holds only each in-flight
+    microbatch's stage INPUT ([K, B, T, D], K = min(M, 2S-1)); the vjp
+    re-runs the stage forward (the same price per-layer remat already
+    pays). Timing: stage s forwards microbatch i at tick i+s and backwards
+    microbatch j at tick j + 2(S-1) - s; activation/grad hops ride
+    ``lax.ppermute`` in opposite directions. The last stage seeds dy from
+    the loss-head vjp in the same tick its forward lands, which is what
+    makes the schedule 1F1B rather than all-forward/all-backward.
+
+    ``scale`` multiplies the cotangent seeds (fp16 loss scaling); the
+    returned loss is unscaled.
+    """
+    from deepspeed_tpu.parallel.mesh import get_mesh
+    mesh = mesh or get_mesh()
+    S = num_stages or mesh.shape["pipe"]
+    attn_fn = attn_fn or transformer.dot_product_attention
+    M, b, t = tokens.shape
+    d = cfg.hidden_size
+    K = min(M, 2 * S - 1)
+    T = M + 2 * (S - 1)
+
+    def per_stage(local_layers, embed, final_norm, head, tokens, labels):
+        sid = lax.axis_index("pipe")
+        is_last = (sid == S - 1)
+        positions = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+        if cfg.pos_emb == "rope":
+            sin, cos = transformer.rope_table(cfg, positions)
+        else:
+            sin = cos = jnp.zeros((b, t, 0), jnp.float32)
+
+        def embed_mb(em, tok):
+            x = em["tokens"][tok]
+            if cfg.pos_emb == "learned":
+                x = x + em["pos"][positions]
+            return x
+
+        def stage_fn(ly, x):
+            y, aux = _stage_forward(cfg, ly, x, sin, cos, attn_fn, moe_fn,
+                                    remat_policy)
+            # for dense models aux is a CONSTANT zero — invariant on
+            # 'pipe' — and jax.vjp would then reject the varying cotangent
+            # seed below; one zero-valued element of x makes it varying
+            # without changing the math
+            aux = aux + x[0, 0, 0].astype(jnp.float32) * 0.0
+            return y, aux
+
+        has_head = head is not None
+
+        def head_loss(fn_, em_, hd_, y, lbl):
+            """Token-mean CE of one microbatch's last-stage output,
+            differentiable w.r.t. the replicated tail params."""
+            np_ = {"final_norm": fn_, "embed": em_}
+            if has_head:
+                np_["lm_head"] = hd_
+            xn = transformer._norm(cfg, fn_, y)
+            return transformer.chunked_cross_entropy(cfg, np_, xn, lbl)
+
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_rev = [(i, (i - 1) % S) for i in range(S)]
+        dtype = embed["tokens"].dtype
+        varying = lambda x: lax.pcast(x, ("pipe",), to="varying")
+        zeros_f32 = lambda tree: jax.tree.map(
+            lambda x: varying(jnp.zeros(x.shape, jnp.float32)), tree)
+        tadd = lambda a, g: jax.tree.map(
+            lambda x, y: x + y.astype(jnp.float32), a, g)
+
+        # replicated-param grad accumulators stay INVARIANT on 'pipe':
+        # each tick's contribution comes back from vjp already psummed
+        # (invariant cotangent), so the accumulator is the global sum on
+        # every stage and satisfies its P() out_spec directly
+        inv_zeros = lambda tree: jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+        carry0 = dict(
+            stash=varying(jnp.zeros((K, b, t, d), dtype)),
+            buf=varying(jnp.zeros((b, t, d), dtype)),
+            dbuf=varying(jnp.zeros((b, t, d), jnp.float32)),
+            g_layers=zeros_f32(local_layers),
+            g_embed=inv_zeros(embed),
+            g_norm=inv_zeros(final_norm),
+            g_head=inv_zeros(head) if has_head else (),
+            loss=varying(jnp.zeros((), jnp.float32)),
+        )
+
+        def tick_body(c, tick):
+            # ---------------- forward slot: microbatch i = tick - sid
+            i = tick - sid
+            fwd_valid = jnp.logical_and(i >= 0, i < M)
+            i_c = jnp.clip(i, 0, M - 1)
+            tok_i = lax.dynamic_index_in_dim(tokens, i_c, 0,
+                                             keepdims=False)
+            x_in = jnp.where(sid == 0, embed_mb(embed, tok_i), c["buf"])
+            x_out, aux = stage_fn(local_layers, x_in)
+            loss_total = c["loss"] + aux * fwd_valid / M
+            slot_f = jnp.mod(i_c, K)
+            old = lax.dynamic_index_in_dim(c["stash"], slot_f, 0,
+                                           keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                c["stash"], jnp.where(fwd_valid, x_in, old), slot_f, 0)
+
+            # ---------------- backward slot: j = tick - 2(S-1) + sid
+            j = tick - 2 * (S - 1) + sid
+            bwd_valid = jnp.logical_and(j >= 0, j < M)
+            j_c = jnp.clip(j, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(stash, jnp.mod(j_c, K), 0,
+                                               keepdims=False)
+            (y_re, _aux_re), stage_vjp = jax.vjp(stage_fn, local_layers,
+                                                 x_saved)
+            lbl_j = lax.dynamic_index_in_dim(labels, j_c, 0,
+                                             keepdims=False)
+            if has_head:
+                ce_j, head_vjp = jax.vjp(
+                    lambda fn_, em_, hd_, y: head_loss(fn_, em_, hd_, y,
+                                                       lbl_j),
+                    final_norm, embed, head, y_re)
+            else:
+                ce_j, head_vjp = jax.vjp(
+                    lambda fn_, em_, y: head_loss(fn_, em_, None, y,
+                                                  lbl_j),
+                    final_norm, embed, y_re)
+            last_seed = (scale / M) * bwd_valid * is_last
+            cots = head_vjp(jnp.float32(1.0) * last_seed)
+            if has_head:
+                dnorm_j, dembed_j, dhead_j, dy_last = cots
+            else:
+                dnorm_j, dembed_j, dy_last = cots
+            loss_total = loss_total + (ce_j / M) * bwd_valid * is_last
+            dy = jnp.where(is_last, dy_last.astype(jnp.float32), c["dbuf"])
+            dy = dy * bwd_valid                     # mask invalid ticks
+            aux_seed = (scale / M) * bwd_valid
+            dlayers_j, dx_j = stage_vjp((dy.astype(y_re.dtype),
+                                         jnp.float32(1.0) * aux_seed))
+            # stage 0: fold dx into the embedding grads
+            tok_j = lax.dynamic_index_in_dim(tokens, j_c, 0,
+                                             keepdims=False)
+            _, em_vjp = jax.vjp(lambda em: embed_mb(em, tok_j), embed)
+            (dembed0,) = em_vjp((dx_j * (sid == 0)).astype(x_in.dtype))
+
+            out = dict(
+                stash=stash,
+                buf=lax.ppermute(x_out, "pipe", perm_fwd),
+                dbuf=lax.ppermute(dx_j.astype(jnp.float32), "pipe",
+                                  perm_rev),
+                g_layers=tadd(c["g_layers"], dlayers_j),
+                g_embed=tadd(tadd(c["g_embed"], dembed_j), dembed0),
+                g_norm=tadd(c["g_norm"], dnorm_j),
+                g_head=tadd(c["g_head"], dhead_j) if has_head else (),
+                loss=loss_total,
+            )
+            return out, None
+
+        c, _ = lax.scan(tick_body, carry0, jnp.arange(T, dtype=jnp.int32))
+        g_layers, g_embed, g_norm, g_head, loss_total = (
+            c["g_layers"], c["g_embed"], c["g_norm"],
+            c["g_head"] if has_head else None, c["loss"])
+
+        loss = lax.psum(loss_total, "pipe")
+        # NO explicit psum on the replicated-param grads: jax.vjp w.r.t. an
+        # INVARIANT (replicated) input inside the manual region already
+        # inserts the psum over 'pipe' to keep the cotangent invariant —
+        # every stage's accumulator therefore already holds the global sum
+        # (psumming again would double-count; caught by the GPipe parity
+        # test). The per-stage layer grads (varying inputs) get no such
+        # implicit psum and stay stage-local, matching their P('pipe')
+        # out_spec.
+        if g_head is not None:
+            return loss, g_layers, g_embed, g_norm, g_head
+        return loss, g_layers, g_embed, g_norm
+
+    layer_specs = jax.tree.map(lambda _: P("pipe"), params["layers"])
+    rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+    head = params.get("lm_head")
+    in_specs = (layer_specs, rep(params["embed"]),
+                rep(params["final_norm"]))
+    if head is None:
+        def entry(ll, em, fn_, tk, lb):
+            return per_stage(ll, em, fn_, None, tk, lb)
+        out = jax.shard_map(
+            entry, mesh=mesh, in_specs=in_specs + (P(), P()),
+            out_specs=(P(), layer_specs, rep(params["embed"]),
+                       rep(params["final_norm"])),
+            axis_names={"pipe"})(params["layers"], params["embed"],
+                                 params["final_norm"], tokens, labels)
+        loss, g_layers, g_embed, g_norm = out
+        grads = {"layers": g_layers, "embed": g_embed,
+                 "final_norm": g_norm}
+    else:
+        out = jax.shard_map(
+            per_stage, mesh=mesh, in_specs=in_specs + (P(), P(), P()),
+            out_specs=(P(), layer_specs, rep(params["embed"]),
+                       rep(params["final_norm"]), P()),
+            axis_names={"pipe"})(params["layers"], params["embed"],
+                                 params["final_norm"], head, tokens,
+                                 labels)
+        loss, g_layers, g_embed, g_norm, g_head = out
+        grads = {"layers": g_layers, "embed": g_embed,
+                 "final_norm": g_norm, "lm_head": g_head}
+    grads = {k: grads[k] for k in params}     # preserve key order
+    return loss, grads

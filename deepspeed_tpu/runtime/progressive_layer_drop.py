@@ -1,0 +1,64 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: ``runtime/progressive_layer_drop.py:10``
+(``ProgressiveLayerDrop``: theta schedule ``(1-theta)·exp(-gamma·t) +
+theta``) and the Bert PLD paper's per-layer keep probability (deeper
+layers drop more). The reference mutates module attributes each step; here
+the schedule is host-side and the stochastic depth itself is a functional
+helper composed into a scanned decoder: the per-layer residual branch is
+multiplied by a Bernoulli keep/(keep_prob) factor — inverted-dropout
+scaling so eval needs no rescale.
+"""
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """theta(t) schedule (reference progressive_layer_drop.py:10)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """Reference update_state: theta ramps from 1 (keep everything)
+        down to the configured floor."""
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+
+def layer_keep_probs(num_layers: int, theta: float) -> jnp.ndarray:
+    """Per-layer keep probability: p_l = 1 - l/L · (1 - theta) — shallow
+    layers almost always run, deep layers drop toward theta (PLD paper
+    eq. 2, reference basic usage in the Bert example)."""
+    l = jnp.arange(1, num_layers + 1, dtype=jnp.float32)
+    return 1.0 - (l / num_layers) * (1.0 - theta)
+
+
+def pld_keep_mask(rng: jax.Array, num_layers: int, theta: float
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample this step's keep decisions. Returns (mask [L] of 0/1,
+    scale [L]) where scale = 1/p for inverted scaling of kept layers."""
+    p = layer_keep_probs(num_layers, theta)
+    keep = (jax.random.uniform(rng, (num_layers,)) < p).astype(jnp.float32)
+    return keep, keep / jnp.maximum(p, 1e-6)
+
+
+def apply_pld_branch(keep_scale: jax.Array, residual: jax.Array,
+                     branch_out: jax.Array) -> jax.Array:
+    """One block's stochastic-depth combine: x + keep/p · f(x). Use inside
+    the layer scan with ``keep_scale = scale[l]``."""
+    return residual + keep_scale * branch_out

@@ -1,0 +1,60 @@
+"""Sparse gradients for embedding tables.
+
+Reference: ``runtime/sparse_tensor.py`` (``SparseTensor`` wrapping torch
+sparse COO grads) + the engine's sparse-grad allreduce
+(engine.py:3023–3095: gather indices/values across DP, deduplicate,
+scatter-add). On TPU dense embedding grads are usually fine (XLA
+scatter-add is fast), but for huge vocab × small batch the sparse
+exchange is the bandwidth win, so the same (indices, values) exchange is
+provided over ``lax.all_gather``.
+"""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass
+class SparseTensor:
+    """COO rows of an [V, D] dense tensor (reference SparseTensor)."""
+    indices: jax.Array      # [N] int32 row ids
+    values: jax.Array       # [N, D]
+    dense_shape: Tuple[int, int]
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    @staticmethod
+    def from_dense(dense: jax.Array, rows: jax.Array) -> "SparseTensor":
+        """Extract the given rows (e.g. the batch's unique token ids)."""
+        return SparseTensor(indices=rows.astype(jnp.int32),
+                            values=dense[rows],
+                            dense_shape=tuple(dense.shape))
+
+
+def sparse_embedding_grad(tokens: jax.Array, dout: jax.Array,
+                          vocab_size: int) -> SparseTensor:
+    """Build the embedding-table gradient sparsely from the batch: row
+    ids are the flattened tokens, values the output grads — never
+    materializing the [V, D] dense grad (reference: torch sparse
+    embedding backward)."""
+    flat_tok = tokens.reshape(-1)
+    flat_g = dout.reshape(-1, dout.shape[-1])
+    return SparseTensor(indices=flat_tok.astype(jnp.int32), values=flat_g,
+                        dense_shape=(vocab_size, dout.shape[-1]))
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """DP allreduce of a sparse grad: all_gather indices+values, keep COO
+    (duplicates combine lazily at ``to_dense``'s scatter-add) — the
+    reference's sparse_allreduce_bucket without the dense round-trip.
+    Must run inside shard_map; result rows = world × local rows."""
+    idx = lax.all_gather(st.indices, axis_name, tiled=True)
+    vals = lax.all_gather(st.values, axis_name, tiled=True)
+    world = lax.psum(1, axis_name)
+    return SparseTensor(indices=idx, values=vals / world,
+                        dense_shape=st.dense_shape)

@@ -1,0 +1,68 @@
+"""TiledLinear — memory-bounded matmul by tile sweep.
+
+Reference: ``runtime/tiling.py`` (``TiledLinear`` splits a big Linear
+into in/out tile sub-linears so ZeRO-3 only gathers one tile at a time).
+TPU version: a ``lax.scan`` (optionally rematerialized) over weight
+tiles — peak live memory is one tile + the accumulator; XLA overlaps the
+tile gathers with compute. Used by ALST's TiledMLP for arbitrary-length
+sequences (reference runtime/sequence_parallel/ulysses_sp.py:838).
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tiled_linear(x: jax.Array, w: jax.Array,
+                 bias: Optional[jax.Array] = None,
+                 in_splits: int = 1, out_splits: int = 1,
+                 remat: bool = True) -> jax.Array:
+    """x: [..., In] @ w: [In, Out] (+bias) with the contraction and/or
+    output dimension swept in tiles.
+
+    in_splits > 1: accumulate partial products over input tiles
+    (reference TiledLinear in_splits); out_splits > 1: concatenate output
+    tiles. Peak live weight = one [In/is, Out/os] tile.
+    """
+    d_in, d_out = w.shape
+    if d_in % in_splits or d_out % out_splits:
+        raise ValueError(f"weight {w.shape} not divisible by splits "
+                         f"({in_splits}, {out_splits})")
+    ti = d_in // in_splits
+    to = d_out // out_splits
+
+    def one_out_tile(wo, bo):
+        """[..., In] x [In, to] via in-tile accumulation."""
+        if in_splits == 1:
+            y = x @ wo
+        else:
+            w_t = wo.reshape(in_splits, ti, to)
+            x_t = jnp.moveaxis(x.reshape(x.shape[:-1] + (in_splits, ti)),
+                               -2, 0)                  # [is, ..., ti]
+
+            def body(acc, wt_xt):
+                wt, xt = wt_xt
+                return acc + xt @ wt, None
+
+            step = jax.checkpoint(body) if remat else body
+            acc0 = jnp.zeros(x.shape[:-1] + (to,), x.dtype)
+            y, _ = lax.scan(step, acc0, (w_t, x_t))
+        return y + bo if bo is not None else y
+
+    if out_splits == 1:
+        return one_out_tile(w, bias)
+    w_o = jnp.moveaxis(w.reshape(d_in, out_splits, to), 1, 0)
+    b_o = (jnp.reshape(bias, (out_splits, to)) if bias is not None
+           else None)
+
+    def out_body(_, wb):
+        wo, bo = wb if b_o is not None else (wb, None)
+        return None, one_out_tile(wo, bo)
+
+    xs = (w_o, b_o) if b_o is not None else w_o
+    _, tiles = lax.scan(out_body, None, xs)            # [os, ..., to]
+    # [os, ..., to] → [..., os, to] → [..., os*to] keeps tile order
+    return jnp.moveaxis(tiles, 0, -2).reshape(x.shape[:-1] + (d_out,))

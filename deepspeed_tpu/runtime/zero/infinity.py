@@ -39,8 +39,9 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
     def __init__(self, abstract_params: Pytree, opt_name: str,
                  opt_params: dict, compute_dtype, nvme_path: str,
                  window: int = DEFAULT_WINDOW, aio_threads: int = 4):
+        # the full-size moments live on NVMe — never allocate them in DRAM
         super().__init__(abstract_params, opt_name, opt_params,
-                         compute_dtype)
+                         compute_dtype, allocate_moments=False)
         os.makedirs(nvme_path, exist_ok=True)
         self.nvme_path = nvme_path
         self.window = int(min(window, self.layout.total))
@@ -54,14 +55,13 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
         self.bytes_read = 0
         self.bytes_written = 0
         self.hyperparams = dict(self.hyperparams, offload="nvme")
-        # moments start as zeros on disk
-        zeros = np.zeros(self.window, np.float32)
-        for name in ("exp_avg", "exp_avg_sq"):
-            for off in range(0, self.layout.total, self.window):
-                n = min(self.window, self.layout.total - off)
-                self.aio.pwrite(self.files[name], zeros[:n], off * 4)
-                self.bytes_written += n * 4
-        self.aio.drain()
+        # pre-size every file SYNCHRONOUSLY before any aio touches it:
+        # ftruncate both zero-fills the moments (sparse) and removes the
+        # fallback writer's create-vs-write race on fresh files
+        nbytes = self.layout.total * 4
+        for path in self.files.values():
+            with open(path, "wb") as fh:
+                fh.truncate(nbytes)
         log_dist(f"ZeRO-Infinity NVMe tier at {nvme_path}: "
                  f"{self.layout.total * 12 / 2**30:.2f} GiB optimizer state "
                  f"on disk, window {self.window / 1e6:.1f}M elements")
@@ -101,22 +101,15 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
     def step_flat(self, flat_g: np.ndarray, lr: float,
                   grad_clip: float = 0.0, loss_scale: float = 1.0,
                   wait_on=None) -> Tuple[Optional[np.ndarray], dict]:
-        if wait_on is not None:
-            import jax as _jax
-            _jax.block_until_ready(wait_on)
-        g = self._widen_grads(np.asarray(flat_g))
-        if loss_scale != 1.0:
-            g *= 1.0 / loss_scale
-        norm = self.adam.grad_norm(g)
-        overflow = not np.isfinite(norm)
-        metrics = {"grad_norm": norm, "overflow": int(overflow), "lr": lr}
-        if overflow:
+        g, metrics = self._prepare_grads(flat_g, loss_scale, grad_clip, lr,
+                                         wait_on)
+        if g is None:
             return None, metrics
-        if grad_clip > 0 and norm > grad_clip:
-            g *= grad_clip / (norm + 1e-6)
 
         self.adam.step_count += 1
-        out = self._out16.view(np.uint16) if self._out16 is not None else \
+        # fp32 compute dtype needs its own output buffer; bf16 narrows
+        # straight into the parent's _out16 via _narrow_range
+        out = None if self._out16 is not None else \
             np.empty(self.layout.total, np.float32)
         nwin = self._num_windows()
         self._submit_read(0)
@@ -139,54 +132,21 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
         return out, metrics
 
     def _adam_window(self, i: int, g: np.ndarray, lr: float) -> None:
-        """One fused Adam sweep over window i's buffers (explicit global
-        step so every window shares the same bias correction)."""
-        import ctypes
+        """One fused Adam sweep over window i's buffers; the math lives in
+        HostAdam.step_buffers (explicit global step so every window shares
+        the same bias correction)."""
         b = {k: self._bufs[k][i % 3] for k in self._bufs}
         n = g.size
-        a = self.adam
-        if self._lib is not None:
-            f32p = lambda arr: arr.ctypes.data_as(
-                ctypes.POINTER(ctypes.c_float))
-            gc = np.ascontiguousarray(g, np.float32)
-            self._lib.ds_host_adam_step(
-                f32p(b["master"]), f32p(gc), f32p(b["exp_avg"]),
-                f32p(b["exp_avg_sq"]), n, a.step_count, lr,
-                a.beta1, a.beta2, a.eps, a.weight_decay,
-                1 if a.adamw_mode else 0)
-            return
-        m, v, p = (b["exp_avg"][:n], b["exp_avg_sq"][:n], b["master"][:n])
-        gg = g.astype(np.float32)
-        if not a.adamw_mode and a.weight_decay:
-            gg = gg + a.weight_decay * p
-        m *= a.beta1
-        m += (1 - a.beta1) * gg
-        v *= a.beta2
-        v += (1 - a.beta2) * gg * gg
-        bc1 = 1 - a.beta1 ** a.step_count
-        bc2 = 1 - a.beta2 ** a.step_count
-        upd = (m / bc1) / (np.sqrt(v / bc2) + a.eps)
-        if a.adamw_mode and a.weight_decay:
-            upd = upd + a.weight_decay * p
-        p -= lr * upd
+        self.adam.step_buffers(b["master"][:n], g, b["exp_avg"][:n],
+                               b["exp_avg_sq"][:n], self.adam.step_count,
+                               lr)
 
     def _narrow_window(self, i: int, out: np.ndarray, off: int, n: int
                        ) -> None:
         """window master → compute-dtype slice of the output flat buffer."""
-        import ctypes
         master = self._bufs["master"][i % 3]
         if self._out16 is not None:
-            if self._lib is not None:
-                self._lib.ds_f32_to_bf16(
-                    master.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                    out[off:off + n].ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_uint16)), n)
-            else:
-                import jax.numpy as jnp
-                import jax
-                out[off:off + n] = np.asarray(
-                    jnp.asarray(master[:n]).astype(jnp.bfloat16)
-                ).view(np.uint16)
+            self._narrow_range(master, off, n)
         else:
             out[off:off + n] = master[:n]
 

@@ -107,7 +107,8 @@ class HostOffloadOptimizer:
     """
 
     def __init__(self, abstract_params: Pytree, opt_name: str,
-                 opt_params: dict, compute_dtype):
+                 opt_params: dict, compute_dtype,
+                 allocate_moments: bool = True):
         name = opt_name.lower()
         if name not in ("adam", "adamw", "fusedadam"):
             raise ValueError(
@@ -121,7 +122,8 @@ class HostOffloadOptimizer:
                              beta1=float(betas[0]), beta2=float(betas[1]),
                              eps=float(p.pop("eps", 1e-8)),
                              weight_decay=float(p.pop("weight_decay", 0.0)),
-                             adamw_mode=(name == "adamw"))
+                             adamw_mode=(name == "adamw"),
+                             allocate_state=allocate_moments)
         self.compute_dtype = compute_dtype
         self.master: Optional[np.ndarray] = None
         self.hyperparams = {"name": f"host_{name}", "offload": "cpu",
@@ -157,17 +159,12 @@ class HostOffloadOptimizer:
             self._g32[:] = flat_g.astype(np.float32)
         return self._g32
 
-    def step_flat(self, flat_g: np.ndarray, lr: float,
-                  grad_clip: float = 0.0, loss_scale: float = 1.0,
-                  wait_on=None) -> Tuple[Optional[np.ndarray], dict]:
-        """Host step over the flat gradient → (flat compute-dtype params
-        or None on overflow, metrics). Runs on the caller's thread.
-
-        ``wait_on`` — a device array backed by the PREVIOUS step's output
-        buffer upload (engine passes the device_put result). Blocking on it
-        here guarantees the in-flight H2D DMA finished reading
-        ``self.master``/``self._out16`` before this step mutates them
-        (overlap mode's buffer-reuse hazard)."""
+    def _prepare_grads(self, flat_g: np.ndarray, loss_scale: float,
+                       grad_clip: float, lr: float, wait_on
+                       ) -> Tuple[Optional[np.ndarray], dict]:
+        """Shared step preamble: wait for the in-flight H2D upload (overlap
+        mode's buffer-reuse hazard), widen/unscale, overflow check, clip.
+        Returns (fp32 grads or None on overflow, metrics)."""
         if wait_on is not None:
             import jax as _jax
             _jax.block_until_ready(wait_on)
@@ -181,19 +178,45 @@ class HostOffloadOptimizer:
             return None, metrics
         if grad_clip > 0 and norm > grad_clip:
             g *= grad_clip / (norm + 1e-6)
+        return g, metrics
+
+    def step_flat(self, flat_g: np.ndarray, lr: float,
+                  grad_clip: float = 0.0, loss_scale: float = 1.0,
+                  wait_on=None) -> Tuple[Optional[np.ndarray], dict]:
+        """Host step over the flat gradient → (flat compute-dtype params
+        or None on overflow, metrics). Runs on the caller's thread.
+
+        ``wait_on`` — a device array backed by the PREVIOUS step's output
+        buffer upload (engine passes the device_put result). Blocking on it
+        guarantees the in-flight H2D DMA finished reading
+        ``self.master``/``self._out16`` before this step mutates them
+        (overlap mode's buffer-reuse hazard)."""
+        g, metrics = self._prepare_grads(flat_g, loss_scale, grad_clip, lr,
+                                         wait_on)
+        if g is None:
+            return None, metrics
         self.adam.step(self.master, g, lr=lr)
         return self._narrow_master(), metrics
+
+    def _narrow_range(self, src: np.ndarray, off: int, n: int) -> None:
+        """fp32 slice of the master → compute-dtype slice of ``_out16``
+        (no-op target when compute dtype is fp32)."""
+        if self._out16 is None:
+            return
+        if self._lib is not None:
+            self._lib.ds_f32_to_bf16(_f32p(src[:n]),
+                                     _u16p(self._out16[off:off + n]), n)
+        else:
+            self._out16[off:off + n] = np.asarray(
+                jnp.asarray(src[:n]).astype(jnp.bfloat16)).view(np.uint16)
 
     def _narrow_master(self) -> np.ndarray:
         """fp32 master → flat compute-dtype array for one device_put."""
         if self._out16 is None:
             return self.master
-        if self._lib is not None:
-            self._lib.ds_f32_to_bf16(_f32p(self.master), _u16p(self._out16),
-                                     self.layout.total)
-            import ml_dtypes
-            return self._out16.view(ml_dtypes.bfloat16)
-        return np.asarray(jnp.asarray(self.master).astype(jnp.bfloat16))
+        self._narrow_range(self.master, 0, self.layout.total)
+        import ml_dtypes
+        return self._out16.view(ml_dtypes.bfloat16)
 
     def step_flat_async(self, flat_g: np.ndarray, lr: float,
                         grad_clip: float = 0.0, loss_scale: float = 1.0,

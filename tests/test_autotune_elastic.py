@@ -1,0 +1,115 @@
+"""Autotuner + elastic agent + NVMe perf tests (reference:
+tests/unit/autotuning/, tests/unit/elasticity/)."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+import jax
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    Preempted, run_elastic)
+from deepspeed_tpu.models.gpt import gpt2_config
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.runtime.engine import initialize
+
+VOCAB, SEQ = 128, 32
+
+
+def _batch_fn(mbs):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, VOCAB, size=(mbs * 8, SEQ),
+                                      dtype=np.int32)}
+
+
+def test_autotuner_picks_feasible_best(tmp_path, devices):
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    tuner = Autotuner(model, base, _batch_fn, micro_batch_sizes=[1, 2],
+                      zero_stages=[2, 3], steps=2, warmup=1)
+    best = tuner.tune(results_dir=str(tmp_path))
+    assert best.feasible and best.throughput > 0
+    assert len(tuner.results) == 4
+    assert os.path.exists(tmp_path / "autotune_results.json")
+    assert os.path.exists(tmp_path / "autotune_best.json")
+    # larger micro-batch should win on throughput for this tiny model
+    assert best.config["train_micro_batch_size_per_gpu"] == 2
+
+
+def test_autotuner_survives_infeasible(devices):
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    # zero stage 7 is invalid -> that candidate is recorded infeasible
+    # instead of aborting the sweep (reference: failed experiment exit)
+    base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    tuner = Autotuner(model, base, _batch_fn, micro_batch_sizes=[1],
+                      zero_stages=[7, 2], steps=1, warmup=0)
+    best = tuner.tune()
+    assert best.config["zero_optimization"]["stage"] == 2
+    assert any(not r.feasible for r in tuner.results)
+
+
+def _engine(tmp_path):
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    eng, *_ = initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}},
+        rng=jax.random.PRNGKey(0))
+    return eng
+
+
+def test_elastic_agent_checkpoints_on_signal(tmp_path, devices):
+    eng = _engine(tmp_path)
+    agent = DSElasticAgent(eng, str(tmp_path))
+    agent.install()
+    try:
+        batch = _batch_fn(1)
+        eng.train_batch(iter([batch]))
+        agent.step_boundary()               # no signal -> no-op
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert agent.preemption_pending
+        eng.train_batch(iter([batch]))      # current step completes
+        with pytest.raises(Preempted) as exc:
+            agent.step_boundary()
+        tag = exc.value.tag
+        assert (tmp_path / tag / "meta.p0.json").exists()
+    finally:
+        agent.uninstall()
+
+    # relaunch: fresh engine resumes from the preemption checkpoint
+    e2 = _engine(tmp_path)
+    agent2 = DSElasticAgent(e2, str(tmp_path))
+    assert agent2.resume() == tag
+    assert e2.global_steps == 2
+
+
+def test_run_elastic_restarts(devices):
+    calls = []
+
+    def train_fn(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise RuntimeError("transient")
+        return "done"
+
+    assert run_elastic(train_fn, max_restarts=3) == "done"
+    assert calls == [0, 1, 2]
+    with pytest.raises(RuntimeError, match="after 1 restarts"):
+        run_elastic(lambda a: (_ for _ in ()).throw(RuntimeError("x")),
+                    max_restarts=1)
+
+
+def test_nvme_perf_sweep(tmp_path):
+    from deepspeed_tpu.nvme.perf import run_sweep
+    out = run_sweep(str(tmp_path), total_mb=2,
+                    configs=[{"threads": 2, "block_kb": 256}],
+                    results_path=str(tmp_path / "io.json"))
+    assert out["results"][0]["read_gbps"] > 0
+    assert out["results"][0]["write_gbps"] > 0
+    assert (tmp_path / "io.json").exists()

@@ -1,0 +1,111 @@
+"""Data-efficiency pipeline tests (reference:
+tests/unit/runtime/test_data_efficiency.py, data_sampling tests)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    DataAnalyzer, DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    IndexedDataset, build_indexed_dataset)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler, random_ltd_indices, random_ltd_layer)
+
+
+def _docs(n=20, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 1000, size=rng.integers(3, 40)).tolist()
+            for _ in range(n)]
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    docs = _docs()
+    ds = build_indexed_dataset(str(tmp_path / "corpus"), docs)
+    assert len(ds) == len(docs)
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(np.asarray(ds[i]), np.asarray(d))
+    np.testing.assert_array_equal(ds.doc_lengths(),
+                                  [len(d) for d in docs])
+    # reopen from disk
+    ds2 = IndexedDataset(str(tmp_path / "corpus"))
+    np.testing.assert_array_equal(np.asarray(ds2[3]), np.asarray(docs[3]))
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    (tmp_path / "x.idx").write_bytes(b"NOTMAGIC" + b"\x00" * 16)
+    (tmp_path / "x.bin").write_bytes(b"")
+    with pytest.raises(ValueError, match="magic"):
+        IndexedDataset(str(tmp_path / "x"))
+
+
+def test_data_analyzer_and_sampler_curriculum(tmp_path):
+    docs = _docs(50, seed=1)
+    ds = build_indexed_dataset(str(tmp_path / "c"), docs)
+    metrics = DataAnalyzer(ds).run(str(tmp_path / "c"))
+    np.testing.assert_array_equal(metrics, [len(d) for d in docs])
+
+    cur = CurriculumScheduler({
+        "curriculum_type": "fixed_linear", "min_difficulty": 8,
+        "max_difficulty": 40,
+        "schedule_config": {"total_curriculum_step": 10,
+                            "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler(metrics, batch_size=8, curriculum=cur,
+                                   seed=3)
+    early = next(sampler)                     # step 0: only short docs
+    assert np.all(metrics[early] <= 8 + 1)
+    sampler.step = 20                         # past the ramp
+    late = next(sampler)
+    assert late.shape == (8,)
+
+    # deterministic resume: same state -> same picks
+    s2 = DeepSpeedDataSampler(metrics, batch_size=8, curriculum=cur,
+                              seed=3)
+    s2.load_state_dict(sampler.state_dict())
+    np.testing.assert_array_equal(next(sampler), next(s2))
+
+
+def test_sampler_dp_sharding():
+    metrics = np.arange(100)
+    shards = []
+    for r in range(4):
+        s = DeepSpeedDataSampler(metrics, batch_size=8, dp_rank=r,
+                                 dp_world=4, seed=7)
+        shards.append(next(s))
+    full = np.concatenate(shards)
+    assert full.shape == (8,)
+    assert len(np.unique(full)) == 8          # disjoint coverage
+
+
+def test_random_ltd_schedule():
+    s = RandomLTDScheduler(start_tokens=16, max_tokens=64,
+                           schedule_step=16, schedule_period=10)
+    assert s.keep_count(0) == 16
+    assert s.keep_count(10) == 32
+    assert s.keep_count(1000) == 64
+
+
+def test_random_ltd_layer_identity_for_dropped():
+    rng = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 8)),
+                    jnp.float32)
+    marker = lambda h: h + 100.0              # visible transformation
+    out = np.asarray(random_ltd_layer(marker, x, rng, keep=4))
+    xn = np.asarray(x)
+    changed = np.isclose(out, xn + 100.0).all(axis=2)
+    untouched = np.isclose(out, xn).all(axis=2)
+    assert changed.sum(axis=1).tolist() == [4, 4]     # exactly K per row
+    assert np.all(changed | untouched)
+    # keep >= T: full pass-through to the layer
+    out_full = np.asarray(random_ltd_layer(marker, x, rng, keep=16))
+    np.testing.assert_allclose(out_full, xn + 100.0)
+
+
+def test_random_ltd_indices_sorted_unique():
+    idx = np.asarray(random_ltd_indices(jax.random.PRNGKey(1), 3, 32, 8))
+    assert idx.shape == (3, 8)
+    for row in idx:
+        assert np.all(np.diff(row) > 0)       # sorted, unique

@@ -1,0 +1,216 @@
+"""OptimizedLinear/LoRA + compression subsystem tests (reference:
+tests/unit/linear/, tests/unit/compression/)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (CompressionConfig,
+                                       CompressionScheduler,
+                                       apply_compression, init_compression,
+                                       redundancy_clean, update_masks)
+from deepspeed_tpu.compression.transforms import (activation_fake_quant,
+                                                  channel_prune_mask,
+                                                  head_prune_mask,
+                                                  magnitude_prune_mask,
+                                                  weight_fake_quant)
+from deepspeed_tpu.linear import (LoRAConfig, QuantizationConfig,
+                                  apply_optimized_linear,
+                                  init_optimized_linear, merge_lora,
+                                  trainable_mask)
+
+
+# ---------------------------------------------------------------------------
+# OptimizedLinear / LoRA
+# ---------------------------------------------------------------------------
+
+def test_lora_starts_as_identity():
+    """lora_b = 0 ⇒ initial output equals the base linear (reference
+    adapter init)."""
+    rng = jax.random.PRNGKey(0)
+    lora = LoRAConfig(lora_r=4)
+    p = init_optimized_linear(rng, 16, 8, lora=lora)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 16))
+    out = apply_optimized_linear(p, x, lora=lora)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ p["base"].T),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lora_grads_only_adapters():
+    """Base is frozen: grads w.r.t. base must be zero (reference
+    requires_grad=False)."""
+    rng = jax.random.PRNGKey(2)
+    lora = LoRAConfig(lora_r=4, lora_alpha=8)
+    p = init_optimized_linear(rng, 16, 8, lora=lora)
+    x = jax.random.normal(jax.random.PRNGKey(3), (3, 16))
+
+    def loss(params):
+        return jnp.sum(apply_optimized_linear(params, x, lora=lora) ** 2)
+
+    g = jax.grad(loss)(p)
+    assert np.all(np.asarray(g["base"]) == 0.0)
+    # lora_b = 0 blocks lora_a's gradient on step 1; lora_b's is live
+    assert np.any(np.asarray(g["lora_b"]) != 0.0)
+    mask = trainable_mask(p)
+    assert mask == {"base": False, "lora_a": True, "lora_b": True}
+
+
+def test_lora_fine_tune_learns():
+    """A few SGD steps on the adapters reduce a regression loss."""
+    rng = jax.random.PRNGKey(4)
+    lora = LoRAConfig(lora_r=4, lora_alpha=8)
+    p = init_optimized_linear(rng, 16, 8, lora=lora)
+    x = jax.random.normal(jax.random.PRNGKey(5), (32, 16))
+    target = jax.random.normal(jax.random.PRNGKey(6), (32, 8))
+
+    def loss(params):
+        return jnp.mean((apply_optimized_linear(params, x, lora=lora)
+                         - target) ** 2)
+
+    l0 = float(loss(p))
+    for _ in range(20):
+        g = jax.grad(loss)(p)
+        p = {k: (v - 0.1 * g[k] if k.startswith("lora_") else v)
+             for k, v in p.items()}
+    assert float(loss(p)) < l0 * 0.9
+
+
+def test_quantized_base_close_and_fused():
+    """int8 base ≈ dense base; merge_lora folds adapters in."""
+    rng = jax.random.PRNGKey(7)
+    lora = LoRAConfig(lora_r=4, lora_alpha=4)
+    quant = QuantizationConfig(q_bits=8, group_size=64)
+    base = jax.random.normal(rng, (8, 16)) * 0.1
+    pq = init_optimized_linear(rng, 16, 8, lora=lora, quant=quant,
+                               base=base)
+    assert pq["base_q"].dtype == jnp.int8 and pq["base_q"].shape == (8, 16)
+    x = jax.random.normal(jax.random.PRNGKey(8), (3, 16))
+    out_q = apply_optimized_linear(pq, x, lora=lora, quant=quant)
+    out_d = x @ base.T
+    assert np.abs(np.asarray(out_q) - np.asarray(out_d)).max() < 0.05
+    # train adapters a little, then merge
+    pq["lora_b"] = jax.random.normal(jax.random.PRNGKey(9), (8, 4)) * 0.1
+    merged = merge_lora(pq, lora, quant=quant)
+    out_m = x @ merged.T
+    out_l = apply_optimized_linear(pq, x, lora=lora, quant=quant)
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_l),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_quantized_base_requires_divisible_groups():
+    with pytest.raises(ValueError, match="divisible"):
+        init_optimized_linear(jax.random.PRNGKey(0), 10, 3,
+                              quant=QuantizationConfig(group_size=64))
+
+
+# ---------------------------------------------------------------------------
+# compression transforms
+# ---------------------------------------------------------------------------
+
+def test_fake_quant_ste_gradient_identity():
+    w = jnp.linspace(-1, 1, 64).reshape(8, 8)
+    g = jax.grad(lambda w: jnp.sum(weight_fake_quant(w, bits=4)))(w)
+    np.testing.assert_allclose(np.asarray(g), np.ones((8, 8)), rtol=1e-6)
+    # forward is actually quantized
+    q = weight_fake_quant(w, bits=4)
+    assert len(np.unique(np.asarray(q))) <= 16
+
+
+def test_activation_fake_quant():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 32)),
+                    jnp.float32)
+    q = activation_fake_quant(x, bits=8)
+    assert np.abs(np.asarray(q) - np.asarray(x)).max() < \
+        float(jnp.max(jnp.abs(x))) / 127 + 1e-6
+
+
+def test_magnitude_prune_ratio():
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((32, 32)),
+                    jnp.float32)
+    mask = magnitude_prune_mask(w, dense_ratio=0.25)
+    m = np.asarray(mask)
+    wn = np.abs(np.asarray(w))
+    assert abs(m.mean() - 0.25) < 0.01
+    # kept entries are the largest
+    assert wn[m == 1].min() >= wn[m == 0].max()
+
+
+def test_head_and_channel_prune():
+    w = jnp.asarray(np.random.default_rng(2).standard_normal((8 * 16, 32)),
+                    jnp.float32)
+    hmask = head_prune_mask(w, num_heads=8, keep=3)
+    assert hmask.shape == (8,) and float(hmask.sum()) == 3
+    cmask = channel_prune_mask(w, dense_ratio=0.5, axis=1)
+    assert cmask.shape == (1, 32) and abs(float(cmask.mean()) - 0.5) < 0.04
+
+
+# ---------------------------------------------------------------------------
+# compression pipeline on a model
+# ---------------------------------------------------------------------------
+
+def test_compression_pipeline_trains(devices):
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.models.transformer import (cross_entropy_loss,
+                                                  forward, init_params)
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = gpt2_config("tiny", max_seq_len=32, vocab_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    ccfg = CompressionConfig(
+        weight_quantization={"enabled": True, "start_bits": 8,
+                             "target_bits": 6, "quantize_period": 2,
+                             "schedule_offset": 1},
+        sparse_pruning={"enabled": True, "dense_ratio": 0.8,
+                        "frequency": 2, "modules": ["layers/*"]})
+    state = init_compression(params, ccfg)
+    assert state.prune_keys and state.wq_keys
+    sched = CompressionScheduler(ccfg)
+
+    # step 0: before offset — no quant
+    sched.advance(0)
+    assert not sched.weight_quant().active
+    sched.advance(1)
+    assert sched.weight_quant().bits == 8
+    sched.advance(6)
+    assert sched.weight_quant().bits == 6      # progressive reduction
+    assert sched.sparse_prune().refresh_due
+    state = update_masks(params, state, ccfg)
+
+    tok = np.random.default_rng(0).integers(0, 128, size=(4, 32),
+                                            dtype=np.int32)
+
+    def loss_fn(p):
+        p = apply_compression(p, state, wq_bits=6, prune=True)
+        logits = forward(cfg, p, jnp.asarray(tok[:, :-1]))
+        return cross_entropy_loss(logits, jnp.asarray(tok[:, 1:]))
+
+    l0 = float(loss_fn(params))
+    g = jax.grad(loss_fn)(params)
+    params2 = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+    assert float(loss_fn(params2)) < l0        # trains through STE
+
+    cleaned = redundancy_clean(params2, state)
+    w = np.asarray(cleaned["layers"]["attn"]["wq"])
+    assert (w == 0).mean() > 0.15              # sparsity actually applied
+
+
+def test_split_merge_params_quantized_grad():
+    """jax.grad over a quantized layer must work via split_params (int8
+    leaves can't be grad inputs)."""
+    from deepspeed_tpu.linear import merge_params, split_params
+    rng = jax.random.PRNGKey(10)
+    lora = LoRAConfig(lora_r=4)
+    quant = QuantizationConfig(group_size=64)
+    p = init_optimized_linear(rng, 32, 16, lora=lora, quant=quant)
+    tr, fz = split_params(p)
+    assert set(tr) == {"lora_a", "lora_b"}
+    x = jax.random.normal(jax.random.PRNGKey(11), (4, 32))
+
+    def loss(tr):
+        return jnp.sum(apply_optimized_linear(merge_params(tr, fz), x,
+                                              lora=lora, quant=quant) ** 2)
+
+    g = jax.grad(loss)(tr)           # must not raise on int8 base
+    assert np.any(np.asarray(g["lora_b"]) != 0.0)

@@ -244,12 +244,13 @@ def test_zero_infinity_nvme_matches_device(tmp_path, devices):
     np.testing.assert_allclose(p_nv, p_dev, rtol=1e-4, atol=1e-5)
     ho = e_nv.host_optimizer
     n = ho.layout.total
-    # disk traffic: init writes (m,v,master) + per-step read/write of all 3
+    # disk traffic: init writes the master (moments are ftruncate-sparse,
+    # not counted) + per-step read/write of all 3 flat files
     assert ho.bytes_read >= 4 * 3 * n * 4, (ho.bytes_read, n)
-    assert ho.bytes_written >= (4 + 1) * 3 * n * 4
+    assert ho.bytes_written >= (4 * 3 + 1) * n * 4
     assert ho._num_windows() >= 4
     for f in ho.files.values():
-        assert os.path.getsize(f) >= n * 4 - ho.window * 4
+        assert os.path.getsize(f) >= n * 4
 
 
 def test_zero_infinity_checkpoint_roundtrip(tmp_path, devices):
@@ -288,3 +289,51 @@ def test_zero_infinity_checkpoint_roundtrip(tmp_path, devices):
         e2.train_batch(iter([b]))
     resumed = jax.device_get(e2.params["embed"]["tokens"])
     np.testing.assert_allclose(final, resumed, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_host_adagrad_matches_device(use_native, devices):
+    """Host (C++ / numpy) Adagrad == device adagrad optimizer."""
+    from deepspeed_tpu.ops.host_adam import HostAdagrad
+    from deepspeed_tpu.ops.optimizers import adagrad
+    from deepspeed_tpu.ops.op_builder import is_native_available
+    if use_native and not is_native_available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    n = 4096
+    p_host = rng.standard_normal(n).astype(np.float32)
+    p_dev = jnp.asarray(p_host.copy())   # copy: zero-copy aliasing on CPU
+    opt = adagrad(eps=1e-10, weight_decay=0.01)
+    st = opt.init(p_dev)
+    host = HostAdagrad(n, eps=1e-10, weight_decay=0.01,
+                       use_native=use_native)
+    for i in range(3):
+        g = rng.standard_normal(n).astype(np.float32)
+        host.step(p_host, g, lr=1e-2)
+        p_dev, st = opt.update(jnp.asarray(g), st, p_dev, jnp.float32(1e-2))
+    np.testing.assert_allclose(p_host, np.asarray(p_dev), rtol=2e-5,
+                               atol=2e-6)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_host_lion_matches_device(use_native, devices):
+    """Host (C++ / numpy) Lion == device lion optimizer."""
+    from deepspeed_tpu.ops.host_adam import HostLion
+    from deepspeed_tpu.ops.optimizers import lion
+    from deepspeed_tpu.ops.op_builder import is_native_available
+    if use_native and not is_native_available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(1)
+    n = 4096
+    p_host = rng.standard_normal(n).astype(np.float32)
+    p_dev = jnp.asarray(p_host.copy())   # copy: zero-copy aliasing on CPU
+    opt = lion(beta1=0.9, beta2=0.99, weight_decay=0.05)
+    st = opt.init(p_dev)
+    host = HostLion(n, beta1=0.9, beta2=0.99, weight_decay=0.05,
+                    use_native=use_native)
+    for i in range(3):
+        g = rng.standard_normal(n).astype(np.float32)
+        host.step(p_host, g, lr=1e-3)
+        p_dev, st = opt.update(jnp.asarray(g), st, p_dev, jnp.float32(1e-3))
+    np.testing.assert_allclose(p_host, np.asarray(p_dev), rtol=2e-5,
+                               atol=2e-6)

@@ -187,3 +187,19 @@ def test_block_reuse_after_flush(devices):
         for u in uids:
             v2.flush(u)
     assert v2.state.allocator.free_blocks == 4
+
+
+def test_max_seq_len_enforced(devices):
+    """Exceeding max_seq_len raises a clear error instead of overflowing
+    the page table (review finding)."""
+    import pytest
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 16, "block_size": 16,
+              "max_seq_len": 32, "prefill_chunk": 16,
+              "max_batch_tokens": 64})
+    rng = np.random.default_rng(0)
+    v2.put([0], [rng.integers(0, 256, size=(30,), dtype=np.int32)])
+    with pytest.raises(ValueError, match="max_seq_len"):
+        v2.put([0], [rng.integers(0, 256, size=(5,), dtype=np.int32)])
