@@ -1,0 +1,86 @@
+"""Coalesced collectives — one launch for many tensors.
+
+Reference: ``runtime/comm/coalesced_collectives.py``
+(``reduce_scatter_coalesced``: flattens a tensor list into per-rank
+contiguous partitions and issues ONE reduce-scatter;
+``all_to_all_quant_reduce`` lives in comm/quantized.py here). On TPU the
+latency win is the same: many small collectives serialize on ICI launch
+overhead, one big flat collective streams at line rate. XLA sometimes
+fuses adjacent collectives itself, but an explicit coalesce is
+deterministic — this is the bucketing knob ``reduce_bucket_size`` /
+``allgather_bucket_size`` map to.
+
+All functions are shard_map-valid.
+"""
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.comms_logger import comms_logger
+
+
+def _flatten(tensors: Sequence[jax.Array], pad_to: int
+             ) -> Tuple[jax.Array, List[Tuple[Tuple[int, ...], int]]]:
+    metas = [(t.shape, int(jnp.size(t))) for t in tensors]
+    flat = jnp.concatenate([t.reshape(-1).astype(jnp.float32)
+                            for t in tensors])
+    pad = (-flat.shape[0]) % pad_to
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat, metas
+
+
+def _unflatten(flat: jax.Array, metas, dtypes) -> List[jax.Array]:
+    out, off = [], 0
+    for (shape, size), dt in zip(metas, dtypes):
+        out.append(lax.dynamic_slice_in_dim(flat, off, size)
+                   .reshape(shape).astype(dt))
+        off += size
+    return out
+
+
+def reduce_scatter_coalesced(tensors: Sequence[jax.Array], axis_name: str,
+                             mean: bool = True) -> jax.Array:
+    """Flatten → ONE tiled reduce-scatter → this device's flat chunk
+    (reference reduce_scatter_coalesced). The chunk stays flat — ZeRO
+    keeps flat partitions; unflatten happens at consumption."""
+    world = lax.psum(1, axis_name)
+    flat, _ = _flatten(tensors, world)
+    comms_logger.append("reduce_scatter_coalesced", flat.nbytes, axis_name)
+    out = lax.psum_scatter(flat, axis_name, tiled=True)
+    return out / world if mean else out
+
+
+def all_reduce_coalesced(tensors: Sequence[jax.Array], axis_name: str,
+                         mean: bool = True) -> List[jax.Array]:
+    """Flatten → ONE psum → unflatten (reference engine
+    buffered_allreduce_fallback:3007 bucketing)."""
+    world = lax.psum(1, axis_name)
+    flat, metas = _flatten(tensors, 1)
+    comms_logger.append("all_reduce_coalesced", flat.nbytes, axis_name)
+    red = lax.psum(flat, axis_name)
+    if mean:
+        red = red / world
+    return _unflatten(red, metas, [t.dtype for t in tensors])
+
+
+def all_gather_coalesced(tensors: Sequence[jax.Array], axis_name: str
+                         ) -> List[jax.Array]:
+    """Flatten local shards → ONE all_gather → per-tensor full arrays,
+    where each input is this device's equal shard of the corresponding
+    output's LEADING dim (reference allgather_bucket path)."""
+    world = lax.psum(1, axis_name)
+    flat, metas = _flatten(tensors, 1)
+    comms_logger.append("all_gather_coalesced", flat.nbytes, axis_name)
+    gat = lax.all_gather(flat, axis_name)            # [world, n]
+    out = []
+    off = 0
+    for (shape, size), t in zip(metas, tensors):
+        piece = lax.dynamic_slice_in_dim(gat, off, size, axis=1)
+        out.append(piece.reshape((world * shape[0],) + tuple(shape[1:]))
+                   .astype(t.dtype))
+        off += size
+    return out

@@ -43,12 +43,15 @@ class CompressionState:
     prune_keys: tuple = ()
 
 
+def _path_key(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def _leaf_items(params: Pytree):
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     for path, leaf in flat:
-        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                       for k in path)
-        yield key, leaf
+        yield _path_key(path), leaf
 
 
 def _matches(key: str, patterns) -> bool:
@@ -101,8 +104,7 @@ def apply_compression(params: Pytree, state: CompressionState,
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     for path, leaf in flat:
-        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                       for k in path)
+        key = _path_key(path)
         x = leaf
         if prune and key in state.prune_keys and key in state.masks:
             x = x * state.masks[key]

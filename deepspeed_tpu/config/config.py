@@ -118,6 +118,10 @@ class OffloadOptimizerConfig(TPUConfigModel):
     #: step t+1 (gradients one step stale). bf16/fp32 only — fp16 dynamic
     #: loss scaling needs the synchronous overflow signal.
     overlap: bool = False
+    #: SuperOffload (reference runtime/superoffload/superoffload_stage3.py):
+    #: bucketed D2H gradient fetch pipelined against the SIMD Adam sweep,
+    #: with a speculative step + rollback instead of a norm pre-pass.
+    superoffload: bool = False
 
 
 class OffloadParamConfig(TPUConfigModel):

@@ -1,0 +1,114 @@
+"""Per-host launch agent.
+
+Reference: ``launcher/launch.py:145`` (per-node agent: spawns one process
+per local rank, exports RANK/WORLD_SIZE env, ``sigkill_handler`` kills
+the tree on failure) + the elastic relaunch path (``--elastic_training``
+in runner.py → DSElasticAgent). TPU translation: ONE worker process per
+host (jax drives every local chip), so the agent's job is environment
+setup, supervision, bounded restarts, and signal forwarding:
+
+- exports the jax distributed rendezvous env
+  (DSTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID — consumed by
+  comm.init_distributed);
+- runs the training command as a child process group;
+- forwards SIGTERM (pod preemption) to the child so the in-process
+  DSElasticAgent (elasticity/elastic_agent.py) can checkpoint;
+- restarts the child up to ``max_restarts`` on nonzero exit (the
+  torchelastic worker-group restart), backing off between attempts.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class LaunchAgent:
+    """Supervise one per-host worker process (reference launch.py main)."""
+
+    def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None,
+                 max_restarts: int = 0, restart_backoff_s: float = 5.0):
+        self.cmd = cmd
+        self.env = {**os.environ, **(env or {})}
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self._child: Optional[subprocess.Popen] = None
+        self._terminating = False
+
+    def _forward(self, signum, _frame) -> None:
+        """SIGTERM/SIGINT → forward to the child's process group so the
+        worker can checkpoint (reference sigkill_handler — but graceful
+        first: preemption gives a drain window)."""
+        self._terminating = True
+        if self._child and self._child.poll() is None:
+            logger.warning(
+                f"launch agent: forwarding {signal.Signals(signum).name} "
+                f"to worker pid {self._child.pid}")
+            try:
+                os.killpg(os.getpgid(self._child.pid), signum)
+            except ProcessLookupError:
+                pass
+
+    def run(self) -> int:
+        prev_term = signal.signal(signal.SIGTERM, self._forward)
+        prev_int = signal.signal(signal.SIGINT, self._forward)
+        try:
+            attempt = 0
+            while True:
+                log_dist(f"launch agent: starting worker "
+                         f"(attempt {attempt + 1}): "
+                         f"{' '.join(self.cmd)}")
+                self._child = subprocess.Popen(
+                    self.cmd, env=self.env, start_new_session=True)
+                rc = self._child.wait()
+                if rc == 0 or self._terminating:
+                    return rc
+                if attempt >= self.max_restarts:
+                    logger.error(
+                        f"launch agent: worker failed (rc={rc}) after "
+                        f"{attempt + 1} attempts; giving up")
+                    return rc
+                attempt += 1
+                logger.warning(
+                    f"launch agent: worker rc={rc}; restart "
+                    f"{attempt}/{self.max_restarts} in "
+                    f"{self.restart_backoff_s}s")
+                time.sleep(self.restart_backoff_s)
+                if self._terminating:
+                    # SIGTERM landed during the backoff (preemption):
+                    # spawning a fresh worker that never saw the signal
+                    # would lose the checkpoint window
+                    logger.warning("launch agent: termination requested "
+                                   "during backoff; not restarting")
+                    return rc
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m deepspeed_tpu.launcher.agent -- cmd args...``
+    with rendezvous env passed through (spawned over ssh by
+    launcher/runner.py on each host)."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-restarts", type=int,
+                    default=int(os.environ.get("DSTPU_MAX_RESTARTS", 0)))
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("usage: agent.py [--max-restarts N] -- prog args...",
+              file=sys.stderr)
+        return 2
+    return LaunchAgent(cmd, max_restarts=args.max_restarts).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Module injection / AutoTP (reference: deepspeed/module_inject/)."""
+
+from deepspeed_tpu.module_inject.auto_tp import (AutoTPPlanner, TPRule,
+                                                 autotp_specs)
+
+__all__ = ["AutoTPPlanner", "TPRule", "autotp_specs"]

@@ -13,6 +13,14 @@ Composes with tensor parallelism (heads sharded over ('model','seq')
 jointly) and GQA (KV heads shard only when divisible; the reference's
 uneven-head path `sequence/layer.py` get_num_kv_heads — here: replicate
 when indivisible).
+
+ALST (reference runtime/sequence_parallel/ulysses_sp.py) mapping:
+``UlyssesSPDataLoaderAdapter``:471 is SUBSUMED — the engine's batch
+sharding already places the sequence dim on the 'seq' axis
+(engine._batch_sharding), so each device holds its T/sp slice without a
+host-side adapter; ``TiledMLP``:838 → runtime/tiling.tiled_linear +
+parallel/fpdt.fpdt_ffn; ``TiledFusedLogitsLoss``:960 →
+models/transformer.chunked_cross_entropy.
 """
 
 from typing import Optional, Tuple

@@ -27,8 +27,7 @@ class DeepSpeedDataSampler:
     def __init__(self, metric_values: Sequence[float],
                  batch_size: int,
                  curriculum: Optional[CurriculumScheduler] = None,
-                 dp_rank: int = 0, dp_world: int = 1, seed: int = 0,
-                 drop_last: bool = True):
+                 dp_rank: int = 0, dp_world: int = 1, seed: int = 0):
         self.metric = np.asarray(metric_values, np.float64)
         self.order = np.argsort(self.metric, kind="stable")
         self.sorted_metric = self.metric[self.order]

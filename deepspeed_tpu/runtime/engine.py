@@ -250,6 +250,13 @@ class DeepSpeedTPUEngine:
                     nvme_path=off_cfg.nvme_path,
                     window=off_cfg.buffer_size or DEFAULT_WINDOW,
                     aio_threads=off_cfg.buffer_count)
+            elif off_cfg.superoffload:
+                from deepspeed_tpu.runtime.zero.superoffload import (
+                    SuperOffloadOptimizer)
+                self.host_optimizer = SuperOffloadOptimizer(
+                    self._abstract_params, self.config.optimizer.type,
+                    self.config.optimizer.params, dtype,
+                    bucket_size=off_cfg.buffer_size or (1 << 22))
             else:
                 from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
                 self.host_optimizer = HostOffloadOptimizer(
@@ -579,20 +586,23 @@ class DeepSpeedTPUEngine:
                 self.lr_schedule(jnp.int32(self.global_steps))))
             scale = float(jax.device_get(self.loss_scale_state.scale)) \
                 if self.fp16_enabled else 1.0
+            # SuperOffload consumes the DEVICE array (bucketed fetch
+            # pipelined against the sweep); the plain path fetches once
+            superoffload = \
+                self.config.zero_optimization.offload_optimizer.superoffload
+            g_arg = flat_g if superoffload else np.asarray(flat_g)
             if self.offload_overlap:
                 self._drain_host_step()          # apply step t-1's update
-                g_np = np.asarray(flat_g)        # blocks on device bwd
                 self._host_future = self.host_optimizer.step_flat_async(
-                    g_np, lr, grad_clip=self.config.gradient_clipping,
+                    g_arg, lr, grad_clip=self.config.gradient_clipping,
                     loss_scale=scale,
                     wait_on=getattr(self, "_last_upload", None))
                 metrics = dict(getattr(self, "_last_host_metrics", None) or
                                {"grad_norm": 0.0, "overflow": 0, "lr": lr})
             else:
-                g_np = np.asarray(flat_g)
                 metrics = self._apply_host_result(
                     self.host_optimizer.step_flat(
-                        g_np, lr, grad_clip=self.config.gradient_clipping,
+                        g_arg, lr, grad_clip=self.config.gradient_clipping,
                         loss_scale=scale))
             metrics["loss"] = loss
             self.global_steps += 1

@@ -49,19 +49,19 @@ class DeepSpeedTPUHybridEngine:
                                                      if engine.bf16_enabled
                                                      else "float32"}
         self._inf: Optional[InferenceEngineTPU] = None
-        self._served_version = -1
-        self._version = 0
-        # count training steps to know when weights moved
-        self._last_global_steps = engine.global_steps
+        # staleness tracking by params IDENTITY: every update path
+        # (train_batch, the 3-call step(), offload's host apply,
+        # load_checkpoint) replaces the immutable params object, so an
+        # `is` check catches them all — a manual version counter on
+        # train_batch alone would miss the delegated paths
+        self._served_params_ref: Any = None
         log_dist("hybrid engine ready: train<->infer flip over shared "
                  "params")
 
     # -- training passthroughs ---------------------------------------------
 
     def train_batch(self, *a, **kw):
-        out = self.engine.train_batch(*a, **kw)
-        self._version += 1
-        return out
+        return self.engine.train_batch(*a, **kw)
 
     def __getattr__(self, name):
         # delegate everything else (save_checkpoint, step counters, ...)
@@ -92,11 +92,12 @@ class DeepSpeedTPUHybridEngine:
                 lambda x: x.astype(self._inf.dtype)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
             self._inf.params = jax.device_put(cast, self._inf._param_sh)
-        self._served_version = self._version
+        self._served_params_ref = self.engine.params
 
     def generate(self, input_ids, **kw) -> np.ndarray:
         """Reference hybrid_engine.py:168 — serve the current weights."""
-        if self._inf is None or self._served_version != self._version:
+        if self._inf is None or \
+                self._served_params_ref is not self.engine.params:
             self.refresh_inference_engine()
         return self._inf.generate(input_ids, **kw)
 

@@ -41,9 +41,12 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig):
         from deepspeed_tpu.parallel.ring import ring_attention
         return partial(ring_attention, axis_name="seq")
     if impl == "pallas_flash" or (impl == "auto" and on_tpu and
-                                  os.environ.get("DSTPU_PALLAS_ATTN")):
-        # mesh-aware Pallas flash kernel; its shard_map head-sharding over
-        # ('model','seq') IS the Ulysses all-to-all when sp > 1
+                                  not os.environ.get("DSTPU_NO_PALLAS_ATTN")):
+        # mesh-aware Pallas flash kernel — the TPU default: measured
+        # 47.9% vs 45.5% MFU against the chunked-XLA path on the 1.27B
+        # seq-2048 bench (v5e); shard_map head-sharding over
+        # ('model','seq') IS the Ulysses all-to-all when sp > 1.
+        # Unsupported shapes fall back inside flash_attention_sharded.
         from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
         return flash_attention_sharded
     if sp.size > 1:

@@ -1,0 +1,125 @@
+"""SuperOffload — bucketed speculative host optimizer step.
+
+Reference: ``runtime/superoffload/superoffload_stage3.py:20``
+(SuperOffloadZeroOptimizer: bucketed optimizer-state transfer, CPUAdam
+worker pool, "speculative" step with rollback — targets GH200-class hosts
+where the CPU↔accelerator link is fast enough that the host step should
+START before the full gradient has landed).
+
+TPU translation: the gradient leaves the device as one flat array; instead
+of blocking on the whole D2H fetch and then sweeping (HostOffloadOptimizer),
+the flat gradient is fetched in BUCKETS on a prefetch thread while the C++
+SIMD Adam sweeps the previous bucket — transfer and compute pipeline. The
+global grad norm is only known after the last bucket, so the sweep runs
+SPECULATIVELY (no pre-pass over the gradient): if the finished norm shows
+an overflow or a clip was needed, the step rolls back from per-step backup
+buffers and (for clip) re-runs with scaled gradients — the reference's
+speculative/rollback design. Cost of the speculation safety net: one extra
+master+moments copy (12 B/param host DRAM) and a rare 2× sweep when a clip
+triggers; win: the host step starts after ONE bucket instead of the full
+transfer.
+"""
+
+import concurrent.futures
+import math
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+from deepspeed_tpu.utils.logging import log_dist
+
+Pytree = Any
+
+#: default bucket: 2^22 elements = 16 MiB fp32 per fetch
+DEFAULT_BUCKET = 1 << 22
+
+
+class SuperOffloadOptimizer(HostOffloadOptimizer):
+    """Drop-in for HostOffloadOptimizer with the bucketed speculative
+    step (``offload_optimizer.device='cpu', superoffload=true``)."""
+
+    def __init__(self, abstract_params: Pytree, opt_name: str,
+                 opt_params: dict, compute_dtype,
+                 bucket_size: int = DEFAULT_BUCKET):
+        super().__init__(abstract_params, opt_name, opt_params,
+                         compute_dtype)
+        self.bucket = int(min(bucket_size, self.layout.total))
+        n = self.layout.total
+        # rollback backups (master + both moments) — the speculation net
+        self._bk_master = np.empty(n, np.float32)
+        self._bk_m = np.empty(n, np.float32)
+        self._bk_v = np.empty(n, np.float32)
+        self._fetcher = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self.speculative_rollbacks = 0
+        log_dist(f"SuperOffload: bucket {self.bucket / 1e6:.1f}M elements, "
+                 f"speculative step with rollback")
+
+    def _nbuckets(self) -> int:
+        return (self.layout.total + self.bucket - 1) // self.bucket
+
+    def _fetch(self, flat_g_dev, i: int) -> np.ndarray:
+        off = i * self.bucket
+        n = min(self.bucket, self.layout.total - off)
+        return np.asarray(flat_g_dev[off:off + n])
+
+    def step_flat(self, flat_g, lr: float, grad_clip: float = 0.0,
+                  loss_scale: float = 1.0, wait_on=None
+                  ) -> Tuple[Optional[np.ndarray], dict]:
+        """``flat_g`` may stay a DEVICE array — buckets are fetched on the
+        prefetch thread while Adam sweeps (the whole point)."""
+        if wait_on is not None:
+            import jax as _jax
+            _jax.block_until_ready(wait_on)
+        a = self.adam
+        nb = self._nbuckets()
+        inv_scale = 1.0 / loss_scale
+        a.step_count += 1
+
+        fut = self._fetcher.submit(self._fetch, flat_g, 0)
+        norm_sq = 0.0
+        for i in range(nb):
+            g_np = fut.result()
+            if i + 1 < nb:
+                fut = self._fetcher.submit(self._fetch, flat_g, i + 1)
+            off = i * self.bucket
+            n = g_np.size
+            sl = slice(off, off + n)
+            g32 = self._g32[sl]
+            if g_np.dtype == np.float32:
+                np.copyto(g32, g_np)
+            else:
+                g32[:] = g_np.astype(np.float32)
+            if loss_scale != 1.0:
+                g32 *= inv_scale
+            norm_sq += float(np.dot(g32.astype(np.float64),
+                                    g32.astype(np.float64)))
+            # speculative: back up THEN update this bucket immediately
+            self._bk_master[sl] = self.master[sl]
+            self._bk_m[sl] = a.exp_avg[sl]
+            self._bk_v[sl] = a.exp_avg_sq[sl]
+            a.step_buffers(self.master[sl], g32, a.exp_avg[sl],
+                           a.exp_avg_sq[sl], a.step_count, lr)
+
+        norm = math.sqrt(norm_sq)
+        overflow = not math.isfinite(norm)
+        metrics = {"grad_norm": norm, "overflow": int(overflow), "lr": lr,
+                   "speculative_rollbacks": self.speculative_rollbacks}
+        if overflow:
+            self._rollback()
+            a.step_count -= 1
+            return None, metrics
+        if grad_clip > 0 and norm > grad_clip:
+            # rare: redo the sweep with clipped grads (reference rollback)
+            self._rollback()
+            self.speculative_rollbacks += 1
+            metrics["speculative_rollbacks"] = self.speculative_rollbacks
+            self._g32 *= grad_clip / (norm + 1e-6)
+            a.step_buffers(self.master, self._g32, a.exp_avg,
+                           a.exp_avg_sq, a.step_count, lr)
+        return self._narrow_master(), metrics
+
+    def _rollback(self) -> None:
+        np.copyto(self.master, self._bk_master)
+        np.copyto(self.adam.exp_avg, self._bk_m)
+        np.copyto(self.adam.exp_avg_sq, self._bk_v)

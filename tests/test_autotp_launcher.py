@@ -1,0 +1,175 @@
+"""AutoTP planner + coalesced collectives + launch agent tests
+(reference: tests/unit/module_inject/, runtime/comm tests,
+tests/unit/launcher/)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.coalesced import (all_gather_coalesced,
+                                          all_reduce_coalesced,
+                                          reduce_scatter_coalesced)
+from deepspeed_tpu.module_inject import AutoTPPlanner, autotp_specs
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+
+# ---------------------------------------------------------------------------
+# AutoTP
+# ---------------------------------------------------------------------------
+
+def _hf_like_params():
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    return {
+        "model": {
+            "embed_tokens": {"weight": mk(128, 32)},
+            "layers": {
+                "0": {
+                    "self_attn": {
+                        "q_proj": {"weight": mk(32, 32)},
+                        "k_proj": {"weight": mk(32, 16)},
+                        "o_proj": {"weight": mk(32, 32)},
+                    },
+                    "mlp": {"gate_proj": {"weight": mk(32, 64)},
+                            "down_proj": {"weight": mk(64, 32)}},
+                    "input_layernorm": {"weight": mk(32)},
+                },
+            },
+        },
+        "lm_head": {"weight": mk(128, 32)},
+    }
+
+
+def test_autotp_classification():
+    params = _hf_like_params()
+    specs = autotp_specs(params, tp_size=2)
+    l0 = specs["model"]["layers"]["0"]
+    assert l0["self_attn"]["q_proj"]["weight"] == P(None, "model")   # col
+    assert l0["self_attn"]["o_proj"]["weight"] == P("model", None)   # row
+    assert l0["mlp"]["gate_proj"]["weight"] == P(None, "model")
+    assert l0["mlp"]["down_proj"]["weight"] == P("model", None)
+    assert l0["input_layernorm"]["weight"] == P()                    # rep
+    # vocab dims
+    assert specs["model"]["embed_tokens"]["weight"] == P("model", None)
+    assert specs["lm_head"]["weight"] == P("model", None)
+
+
+def test_autotp_indivisible_falls_back_with_warning(caplog):
+    params = {"q_proj": {"weight": jnp.zeros((32, 30))}}  # 30 % 4 != 0
+    specs = autotp_specs(params, tp_size=4)
+    assert specs["q_proj"]["weight"] == P()
+
+
+def test_autotp_specs_are_placeable(devices):
+    """The plan must actually place an HF-like tree on a TP mesh and the
+    sharded matmul must equal the dense one."""
+    mesh = build_mesh(data=4, model=2)
+    params = _hf_like_params()
+    specs = autotp_specs(params, tp_size=2,
+                         fsdp_axes=("data", "data_inner", "expert"))
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+    w_col = placed["model"]["layers"]["0"]["self_attn"]["q_proj"]["weight"]
+    w_row = placed["model"]["layers"]["0"]["self_attn"]["o_proj"]["weight"]
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((4, 32)),
+                    jnp.float32)
+    got = jax.jit(lambda x, a, b: (x @ a) @ b)(x, w_col, w_row)
+    attn = params["model"]["layers"]["0"]["self_attn"]
+    ref = (np.asarray(x) @ np.asarray(attn["q_proj"]["weight"])) @ \
+        np.asarray(attn["o_proj"]["weight"])
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# coalesced collectives
+# ---------------------------------------------------------------------------
+
+def test_reduce_scatter_coalesced(devices):
+    mesh = build_mesh(data=8)
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((8, 16)).astype(np.float32)
+    b = rng.standard_normal((8, 24)).astype(np.float32)
+
+    def f(a, b):
+        return reduce_scatter_coalesced([a[0], b[0]], "data", mean=True)
+
+    out = shard_map(f, mesh=mesh,
+                    in_specs=(P("data", None), P("data", None)),
+                    out_specs=P(("data",)), check_vma=False)(
+        jnp.asarray(a), jnp.asarray(b))
+    flat_mean = np.concatenate([a.mean(0), b.mean(0)])
+    np.testing.assert_allclose(np.asarray(out), flat_mean, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_all_reduce_and_gather_coalesced(devices):
+    mesh = build_mesh(data=8)
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((8, 8)).astype(np.float32)
+    b = rng.standard_normal((8, 4)).astype(np.float32)
+
+    def f(a, b):
+        ra, rb = all_reduce_coalesced([a[0], b[0]], "data", mean=True)
+        ga, gb = all_gather_coalesced([a[0:1].reshape(1, 8),
+                                       b[0:1].reshape(1, 4)], "data")
+        return ra, rb, ga, gb
+
+    ra, rb, ga, gb = shard_map(
+        f, mesh=mesh, in_specs=(P("data", None), P("data", None)),
+        out_specs=(P(), P(), P(), P()), check_vma=False)(
+        jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(ra), a.mean(0), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rb), b.mean(0), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga), a, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(gb), b, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# launch agent
+# ---------------------------------------------------------------------------
+
+def test_launch_agent_restarts(tmp_path):
+    """Worker fails twice then succeeds; the agent restarts within the
+    budget (reference DSElasticAgent restart semantics)."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import sys, pathlib\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n")
+    from deepspeed_tpu.launcher.agent import LaunchAgent
+    agent = LaunchAgent([sys.executable, str(script)], max_restarts=3,
+                        restart_backoff_s=0.01)
+    assert agent.run() == 0
+    assert marker.read_text() == "3"
+
+
+def test_launch_agent_gives_up(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    from deepspeed_tpu.launcher.agent import LaunchAgent
+    agent = LaunchAgent([sys.executable, str(script)], max_restarts=1,
+                        restart_backoff_s=0.01)
+    assert agent.run() == 7
+
+
+def test_launch_agent_cli(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.agent", "--",
+         sys.executable, "-c", "print('worker ran')"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.getcwd()})
+    assert out.returncode == 0

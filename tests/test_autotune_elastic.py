@@ -35,8 +35,9 @@ def test_autotuner_picks_feasible_best(tmp_path, devices):
     assert len(tuner.results) == 4
     assert os.path.exists(tmp_path / "autotune_results.json")
     assert os.path.exists(tmp_path / "autotune_best.json")
-    # larger micro-batch should win on throughput for this tiny model
-    assert best.config["train_micro_batch_size_per_gpu"] == 2
+    # the winner is the max-throughput feasible candidate (which specific
+    # one wins is timing-dependent on a loaded CI box — don't assert it)
+    assert best.throughput == max(r.throughput for r in tuner.results)
 
 
 def test_autotuner_survives_infeasible(devices):
