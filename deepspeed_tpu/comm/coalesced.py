@@ -24,6 +24,14 @@ from deepspeed_tpu.comm.comms_logger import comms_logger
 
 def _flatten(tensors: Sequence[jax.Array], pad_to: int
              ) -> Tuple[jax.Array, List[Tuple[Tuple[int, ...], int]]]:
+    """Reductions run in fp32; int/fp64 inputs would silently round-trip
+    through fp32 and corrupt (e.g. int32 ids > 2^24) — reject them."""
+    for t in tensors:
+        if not jnp.issubdtype(t.dtype, jnp.floating) or \
+                t.dtype == jnp.float64:
+            raise TypeError(
+                f"coalesced collectives take inexact ≤32-bit dtypes "
+                f"(got {t.dtype}); gather ints per-tensor instead")
     metas = [(t.shape, int(jnp.size(t))) for t in tensors]
     flat = jnp.concatenate([t.reshape(-1).astype(jnp.float32)
                             for t in tensors])

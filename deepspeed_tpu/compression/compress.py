@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from deepspeed_tpu.compression.config import CompressionConfig
 from deepspeed_tpu.compression.transforms import (magnitude_prune_mask,
                                                   weight_fake_quant)
+from deepspeed_tpu.utils.pytree import leaf_items as _leaf_items
+from deepspeed_tpu.utils.pytree import path_key as _path_key
 
 Pytree = Any
 
@@ -41,17 +43,6 @@ class CompressionState:
     masks: Dict[str, jax.Array] = field(default_factory=dict)
     wq_keys: tuple = ()
     prune_keys: tuple = ()
-
-
-def _path_key(path) -> str:
-    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                    for k in path)
-
-
-def _leaf_items(params: Pytree):
-    flat, _ = jax.tree_util.tree_flatten_with_path(params)
-    for path, leaf in flat:
-        yield _path_key(path), leaf
 
 
 def _matches(key: str, patterns) -> bool:

@@ -123,6 +123,14 @@ class OffloadOptimizerConfig(TPUConfigModel):
     #: with a speculative step + rollback instead of a norm pre-pass.
     superoffload: bool = False
 
+    @model_validator(mode="after")
+    def _validate_superoffload(self) -> "OffloadOptimizerConfig":
+        if self.superoffload and self.device.value != "cpu":
+            raise ValueError(
+                "offload_optimizer.superoffload requires device='cpu' "
+                "(the NVMe tier has its own windowed pipeline)")
+        return self
+
 
 class OffloadParamConfig(TPUConfigModel):
     """Reference: runtime/zero/offload_config.py:DeepSpeedZeroOffloadParamConfig."""

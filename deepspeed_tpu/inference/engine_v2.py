@@ -78,7 +78,13 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
         q, k, v = qkv_project(cfg, lp["attn"], h_in, sin, cos)
         ak, av = pa.write_kv(ak, av, k, v, page_table, starts, counts)
         out = attend(q, ak, av, page_table, starts, counts)
-        h = x + attn_out_project(cfg, lp["attn"], out)
+        attn_out = attn_out_project(cfg, lp["attn"], out)
+        if cfg.parallel_block:
+            ff = (moe_fn(cfg, lp["moe"], h_in)[0]
+                  if cfg.num_experts and moe_fn is not None
+                  else _mlp(cfg, lp["mlp"], h_in))
+            return x + attn_out + ff, (ak, av)
+        h = x + attn_out
         normed = _norm(cfg, lp["ln2"], h)
         if cfg.num_experts and moe_fn is not None:
             ff, _ = moe_fn(cfg, lp["moe"], normed)

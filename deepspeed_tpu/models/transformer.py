@@ -50,6 +50,12 @@ class DecoderConfig:
     use_bias: bool = True
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
+    #: parallel residual (GPT-J/NeoX/Falcon/Phi): h = x + attn(n(x)) +
+    #: mlp(n(x)) with ONE shared pre-norm — no ln2
+    parallel_block: bool = False
+    #: partial rotary (GPT-NeoX rotary_pct / GPT-J rotary_dim): RoPE on
+    #: the first rotary_pct of each head's dims, pass-through on the rest
+    rotary_pct: float = 1.0
     # MoE (used by mixtral preset; dense when num_experts == 0)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -63,6 +69,12 @@ class DecoderConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def rope_dim(self) -> int:
+        """Dims per head that get RoPE (even; rotary_pct of head_dim)."""
+        r = int(self.head_dim * self.rotary_pct)
+        return r - (r % 2)
 
     @property
     def ffn_size(self) -> int:
@@ -119,20 +131,29 @@ def _norm_params(cfg: DecoderConfig, shape_prefix=()) -> Params:
 # ---------------------------------------------------------------------------
 
 def rope_table(cfg: DecoderConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """positions: [B, T] int32 → (sin, cos) each [B, T, head_dim//2]."""
-    half = cfg.head_dim // 2
+    """positions: [B, T] int32 → (sin, cos) each [B, T, rope_dim//2]
+    (rope_dim == head_dim unless rotary_pct < 1 — GPT-NeoX partial
+    rotary)."""
+    half = cfg.rope_dim // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,half]
     return jnp.sin(angles), jnp.cos(angles)
 
 
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
-    """x: [B, T, H, Dh]; rotate-half convention (Llama)."""
-    x1, x2 = jnp.split(x, 2, axis=-1)
+    """x: [B, T, H, Dh]; rotate-half convention (Llama). When the table
+    covers fewer dims than Dh (partial rotary), the tail passes through
+    unrotated (GPT-NeoX/GPT-J convention)."""
+    rot = 2 * sin.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = jnp.split(x_rot, 2, axis=-1)
     sin = sin[:, :, None, :]
     cos = cos[:, :, None, :]
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                           axis=-1).astype(x.dtype)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+    if x_pass.shape[-1]:
+        rotated = jnp.concatenate([rotated, x_pass], axis=-1)
+    return rotated.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -253,9 +274,18 @@ def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
                   ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hidden, aux_loss) — aux is 0 for dense blocks, the scaled
     load-balance loss for MoE blocks (reference sharded_moe.py l_aux)."""
-    attn_out = _attention_block(cfg, p["attn"], _norm(cfg, p["ln1"], x),
-                                sin, cos, attn_fn)
+    pre = _norm(cfg, p["ln1"], x)
+    attn_out = _attention_block(cfg, p["attn"], pre, sin, cos, attn_fn)
     attn_out = checkpoint_name(attn_out, "attn_out")
+    if cfg.parallel_block:
+        # GPT-J/NeoX/Falcon parallel residual: one shared pre-norm feeds
+        # BOTH branches; attention and MLP matmuls overlap on the MXU
+        if cfg.num_experts and moe_fn is not None:
+            ff, aux = moe_fn(cfg, p["moe"], pre)
+        else:
+            ff = _mlp(cfg, p["mlp"], pre)
+            aux = jnp.zeros((), jnp.float32)
+        return x + attn_out + ff, aux
     h = x + attn_out
     normed = _norm(cfg, p["ln2"], h)
     if cfg.num_experts and moe_fn is not None:
@@ -294,8 +324,9 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     layers: Params = {
         "attn": attn,
         "ln1": _norm_params(cfg, (L,)),
-        "ln2": _norm_params(cfg, (L,)),
     }
+    if not cfg.parallel_block:
+        layers["ln2"] = _norm_params(cfg, (L,))
     if cfg.num_experts:
         E = cfg.num_experts
         layers["moe"] = {
@@ -533,6 +564,11 @@ def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
         h_in = _norm(cfg, layer_params["ln1"], x)
         attn_out, k_c, v_c = _cached_attention(
             cfg, layer_params["attn"], h_in, sin, cos, k_c, v_c, cache_len)
+        if cfg.parallel_block:
+            ff = (moe_fn(cfg, layer_params["moe"], h_in)[0]
+                  if cfg.num_experts and moe_fn is not None
+                  else _mlp(cfg, layer_params["mlp"], h_in))
+            return x + attn_out + ff, (k_c, v_c)
         h = x + attn_out
         normed = _norm(cfg, layer_params["ln2"], h)
         if cfg.num_experts and moe_fn is not None:
@@ -590,11 +626,13 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
     layers: Params = {
         "attn": attn,
         "ln1": {"scale": spec(None, None)},
-        "ln2": {"scale": spec(None, None)},
     }
+    if not cfg.parallel_block:
+        layers["ln2"] = {"scale": spec(None, None)}
     if cfg.norm == "layernorm" and cfg.use_bias:
         layers["ln1"]["bias"] = spec(None, None)
-        layers["ln2"]["bias"] = spec(None, None)
+        if not cfg.parallel_block:
+            layers["ln2"]["bias"] = spec(None, None)
 
     if cfg.num_experts:
         # expert weights: E dim sharded over 'expert'; FSDP restricted to

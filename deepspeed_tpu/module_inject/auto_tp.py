@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.pytree import path_key
 
 Pytree = Any
 
@@ -41,7 +42,7 @@ _COL_PATTERNS = ("q_proj", "k_proj", "v_proj", "qkv", "wq", "wk", "wv",
                  "gate_proj", "up_proj", "wi", "wg", "w1", "w3",
                  "fc1", "fc_in", "dense_h_to_4h", "query", "key", "value")
 _ROW_PATTERNS = ("o_proj", "out_proj", "wo", "down_proj", "w2", "fc2",
-                 "fc_out", "dense_4h_to_h", "attn.dense", "proj_out")
+                 "fc_out", "dense_4h_to_h", "attention/dense", "proj_out")
 _VOCAB_PATTERNS = ("embed", "wte", "lm_head", "word_embeddings")
 _SKIP_PATTERNS = ("norm", "ln", "bias", "rotary", "scale")
 
@@ -104,8 +105,7 @@ class AutoTPPlanner:
         specs = []
         counts = {"column": 0, "row": 0, "vocab": 0, "replicate": 0}
         for path, leaf in flat:
-            key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                           for k in path)
+            key = path_key(path)
             rule = self.classify(key, leaf)
             nd = np.ndim(leaf)
             entries: List[Any] = [None] * nd

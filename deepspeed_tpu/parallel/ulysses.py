@@ -30,18 +30,27 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.comms_logger import comms_logger
+from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.models.transformer import dot_product_attention
 from deepspeed_tpu.parallel.mesh import ZERO_AXES, get_mesh
 
 
 def _head_sharding(n_heads_axis_size: int, mesh, axis_name: str,
                    with_tp: bool):
-    """Pick the head-dim sharding for attention time; None if indivisible."""
+    """Pick the head-dim sharding for attention time; None if indivisible
+    (logged — a silent fallback hides a mis-sized mesh, VERDICT r1 #8)."""
     total = mesh.shape[axis_name] * (mesh.shape["model"] if with_tp else 1)
     if n_heads_axis_size % total == 0:
         return ("model", axis_name) if with_tp else axis_name
     if with_tp and n_heads_axis_size % mesh.shape["model"] == 0:
+        logger.warning(
+            f"ulysses: {n_heads_axis_size} heads not divisible by "
+            f"model×seq={total}; sharding heads over 'model' only")
         return "model"
+    logger.warning(
+        f"ulysses: {n_heads_axis_size} heads not divisible by "
+        f"{'model×' if with_tp else ''}{axis_name}={total}; replicating "
+        f"heads (attention loses the SP/TP split — resize the mesh)")
     return None
 
 

@@ -122,6 +122,13 @@ class DeepSpeedTPUEngine:
         self.skipped_steps = 0
         self.global_samples = 0
 
+        # sanity checks (reference engine.py:1123 is_sanity_checks_enabled:
+        # NaN/Inf guards + cross-rank dataloader consistency :520). The
+        # jax analogue: debug_nans raises at the op that produced the NaN.
+        if config.check_nan_inf:
+            jax.config.update("jax_debug_nans", True)
+            log_dist("sanity checks on: jax_debug_nans enabled")
+
         # -- optimizer & schedule ------------------------------------------
         self.offload_enabled = (
             config.zero_optimization.offload_optimizer.device.value
@@ -574,6 +581,8 @@ class DeepSpeedTPUEngine:
         it = data_iter if data_iter is not None else self._own_data_iterator()
         micros = [next(it) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
+        if self.config.check_nan_inf:
+            self._check_batch_consistency(micros)   # ALL microbatches
         batch = self._place_stacked_batch(batch)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
@@ -587,9 +596,13 @@ class DeepSpeedTPUEngine:
             scale = float(jax.device_get(self.loss_scale_state.scale)) \
                 if self.fp16_enabled else 1.0
             # SuperOffload consumes the DEVICE array (bucketed fetch
-            # pipelined against the sweep); the plain path fetches once
-            superoffload = \
-                self.config.zero_optimization.offload_optimizer.superoffload
+            # pipelined against the sweep); the plain path fetches once.
+            # Keyed off the optimizer actually built — the config flag
+            # alone could disagree (e.g. device='nvme' wins over it)
+            from deepspeed_tpu.runtime.zero.superoffload import (
+                SuperOffloadOptimizer)
+            superoffload = isinstance(self.host_optimizer,
+                                      SuperOffloadOptimizer)
             g_arg = flat_g if superoffload else np.asarray(flat_g)
             if self.offload_overlap:
                 self._drain_host_step()          # apply step t-1's update
@@ -626,6 +639,25 @@ class DeepSpeedTPUEngine:
         self.tput_timer.stop(sync=loss)
         self._write_monitor(metrics)
         return loss
+
+    def _check_batch_consistency(self, micros) -> None:
+        """Cross-process dataloader consistency (reference
+        check_dataloader_inputs_same_across_ranks engine.py:520): every
+        process must feed the same global batch or the SPMD step silently
+        trains on garbage. Hash ALL microbatches, allgather, compare."""
+        if jax.process_count() <= 1:
+            return
+        import hashlib
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(micros):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        digest = np.frombuffer(h.digest()[:8], np.int64)
+        from jax.experimental import multihost_utils
+        all_digests = multihost_utils.process_allgather(digest)
+        if not np.all(all_digests == digest):
+            raise RuntimeError(
+                "sanity check failed: dataloader batches differ across "
+                "processes (reference engine.py:520 check)")
 
     def _apply_host_result(self, result) -> Dict[str, Any]:
         """Upload the host step's flat master (ONE device_put + jitted

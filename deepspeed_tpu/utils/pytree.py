@@ -1,0 +1,21 @@
+"""Pytree path utilities shared by compression / AutoTP / debug tooling
+(reference analogue: the module-name walks in module_inject and
+compression both key layers by dotted module paths)."""
+
+from typing import Any, Iterator, Tuple
+
+import jax
+
+
+def path_key(path) -> str:
+    """Canonical '/'-joined string for a tree_flatten_with_path path —
+    the ONE place the key format lives (DictKey/SequenceKey/attr names)."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def leaf_items(params: Any) -> Iterator[Tuple[str, Any]]:
+    """(path_key, leaf) pairs of a pytree."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        yield path_key(path), leaf

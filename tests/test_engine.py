@@ -196,3 +196,62 @@ def test_dataloader_and_train(devices):
     it = iter(RepeatingLoader(loader))
     loss = engine.train_batch(it)
     assert np.isfinite(float(loss))
+
+
+def test_check_nan_inf_sanity(devices):
+    """check_nan_inf enables jax_debug_nans: a NaN-producing step raises
+    at the op instead of training on garbage (reference engine.py:1123
+    sanity checks)."""
+    import jax as _jax
+    from deepspeed_tpu.runtime.engine import ModelSpec, initialize
+    build_mesh(data=8)
+
+    def init_fn(rng):
+        return {"w": jnp.ones((8,), jnp.float32)}
+
+    def loss_fn(params, batch, rng):
+        # 0/0 on the first step -> NaN
+        return jnp.sum(params["w"] * batch["x"] / batch["x"])
+
+    spec = ModelSpec(init_fn=init_fn, loss_fn=loss_fn)
+    eng, *_ = initialize(
+        model=spec,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "sgd", "params": {"lr": 1e-2}},
+                "check_nan_inf": True},
+        rng=jax.random.PRNGKey(0))
+    try:
+        assert _jax.config.jax_debug_nans
+        with pytest.raises(Exception):     # FloatingPointError at the op
+            eng.train_batch(iter([{"x": np.zeros((8, 8), np.float32)}]))
+    finally:
+        _jax.config.update("jax_debug_nans", False)
+
+
+def test_custom_attention_registry(devices):
+    """attention_impl can select a user-registered implementation
+    (reference inference/v2/modules pluggable registry)."""
+    from deepspeed_tpu.models.transformer import dot_product_attention
+    from deepspeed_tpu.runtime.engine import initialize
+    from deepspeed_tpu.runtime.model_factory import register_attention_impl
+
+    calls = []
+
+    def my_attn(q, k, v, causal=True, q_offset=0):
+        calls.append(q.shape)
+        return dot_product_attention(q, k, v, causal=causal,
+                                     q_offset=q_offset)
+
+    register_attention_impl("my_attn", my_attn)
+    build_mesh(data=8)
+    from deepspeed_tpu.models.gpt import gpt2_config
+    eng, *_ = initialize(
+        model=gpt2_config("tiny", max_seq_len=32, vocab_size=128),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "attention_impl": "my_attn",
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+        rng=jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(8, 32), dtype=np.int32)}
+    loss = float(eng.train_batch(iter([batch])))
+    assert np.isfinite(loss) and calls     # custom impl was traced
