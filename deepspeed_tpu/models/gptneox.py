@@ -20,7 +20,9 @@ def gptneox_config(size: str = "20b", **overrides) -> DecoderConfig:
     base = dict(vocab_size=50432, max_seq_len=2048, norm="layernorm",
                 activation="gelu", pos_emb="rope", rope_theta=10000.0,
                 rotary_pct=0.25, use_bias=True, tie_embeddings=False,
-                parallel_block=True)
+                # NeoX parallel residual uses SEPARATE input/post_attention
+                # norms on x (HF use_parallel_residual)
+                parallel_block=True, parallel_block_norms=2)
     base.update(presets[size])
     base.update(overrides)
     return DecoderConfig(**base)

@@ -194,6 +194,11 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     here load in transformers."""
     import jax
     from safetensors.numpy import save_file
+    if cfg.parallel_block:
+        raise NotImplementedError(
+            "export_hf_checkpoint maps the llama-family layout only; "
+            "parallel-residual models (falcon/gptneox presets) need their "
+            "own key mapping — not implemented yet")
 
     os.makedirs(out_dir, exist_ok=True)
     host = jax.tree.map(

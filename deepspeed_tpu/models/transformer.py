@@ -50,9 +50,16 @@ class DecoderConfig:
     use_bias: bool = True
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
-    #: parallel residual (GPT-J/NeoX/Falcon/Phi): h = x + attn(n(x)) +
-    #: mlp(n(x)) with ONE shared pre-norm — no ln2
+    #: parallel residual (GPT-J/NeoX/Falcon/Phi): h = x + attn(...) +
+    #: mlp(...)
     parallel_block: bool = False
+    #: 1 = ONE shared pre-norm feeds both branches (GPT-J / Falcon-7B /
+    #: Phi); 2 = separate input/post_attention norms on x (GPT-NeoX /
+    #: Pythia / Falcon-40B new_decoder_architecture)
+    parallel_block_norms: int = 1
+    #: LayerNorm bias independent of linear biases (Falcon: bias-less
+    #: linears but LNs WITH bias). None → follow use_bias.
+    norm_bias: Optional[bool] = None
     #: partial rotary (GPT-NeoX rotary_pct / GPT-J rotary_dim): RoPE on
     #: the first rotary_pct of each head's dims, pass-through on the rest
     rotary_pct: float = 1.0
@@ -69,6 +76,16 @@ class DecoderConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def ln_bias(self) -> bool:
+        if self.norm != "layernorm":
+            return False
+        return self.use_bias if self.norm_bias is None else self.norm_bias
+
+    @property
+    def has_ln2(self) -> bool:
+        return (not self.parallel_block) or self.parallel_block_norms == 2
 
     @property
     def rope_dim(self) -> int:
@@ -121,7 +138,7 @@ def _norm(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
 
 def _norm_params(cfg: DecoderConfig, shape_prefix=()) -> Params:
     p = {"scale": jnp.ones(shape_prefix + (cfg.hidden_size,), jnp.float32)}
-    if cfg.norm == "layernorm" and cfg.use_bias:
+    if cfg.ln_bias:
         p["bias"] = jnp.zeros(shape_prefix + (cfg.hidden_size,), jnp.float32)
     return p
 
@@ -277,22 +294,32 @@ def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
     pre = _norm(cfg, p["ln1"], x)
     attn_out = _attention_block(cfg, p["attn"], pre, sin, cos, attn_fn)
     attn_out = checkpoint_name(attn_out, "attn_out")
-    if cfg.parallel_block:
-        # GPT-J/NeoX/Falcon parallel residual: one shared pre-norm feeds
-        # BOTH branches; attention and MLP matmuls overlap on the MXU
+    return block_combine(cfg, p, x, pre, attn_out, moe_fn)
+
+
+def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
+                  pre: jax.Array, attn_out: jax.Array,
+                  moe_fn: Optional[Callable]) -> Tuple[jax.Array, jax.Array]:
+    """Residual combine shared by training, cached decode, and ragged
+    inference (one home for the parallel/sequential branch math).
+
+    Parallel (GPT-J/NeoX/Falcon): h = x + attn + mlp(src) where src is
+    the shared pre-norm (1-norm variants) or a separate ln2(x) (NeoX /
+    Falcon-40B 2-norm variants); attention and MLP matmuls overlap on the
+    MXU. Sequential (GPT-2/Llama): post-attention pre-norm MLP.
+    """
+    def ffn(src):
         if cfg.num_experts and moe_fn is not None:
-            ff, aux = moe_fn(cfg, p["moe"], pre)
-        else:
-            ff = _mlp(cfg, p["mlp"], pre)
-            aux = jnp.zeros((), jnp.float32)
+            return moe_fn(cfg, p["moe"], src)
+        return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32)
+
+    if cfg.parallel_block:
+        src = _norm(cfg, p["ln2"], x) if cfg.parallel_block_norms == 2 \
+            else pre
+        ff, aux = ffn(src)
         return x + attn_out + ff, aux
     h = x + attn_out
-    normed = _norm(cfg, p["ln2"], h)
-    if cfg.num_experts and moe_fn is not None:
-        ff, aux = moe_fn(cfg, p["moe"], normed)
-    else:
-        ff = _mlp(cfg, p["mlp"], normed)
-        aux = jnp.zeros((), jnp.float32)
+    ff, aux = ffn(_norm(cfg, p["ln2"], h))
     return h + ff, aux
 
 
@@ -325,7 +352,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
         "attn": attn,
         "ln1": _norm_params(cfg, (L,)),
     }
-    if not cfg.parallel_block:
+    if cfg.has_ln2:
         layers["ln2"] = _norm_params(cfg, (L,))
     if cfg.num_experts:
         E = cfg.num_experts
@@ -564,18 +591,9 @@ def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
         h_in = _norm(cfg, layer_params["ln1"], x)
         attn_out, k_c, v_c = _cached_attention(
             cfg, layer_params["attn"], h_in, sin, cos, k_c, v_c, cache_len)
-        if cfg.parallel_block:
-            ff = (moe_fn(cfg, layer_params["moe"], h_in)[0]
-                  if cfg.num_experts and moe_fn is not None
-                  else _mlp(cfg, layer_params["mlp"], h_in))
-            return x + attn_out + ff, (k_c, v_c)
-        h = x + attn_out
-        normed = _norm(cfg, layer_params["ln2"], h)
-        if cfg.num_experts and moe_fn is not None:
-            ff, _ = moe_fn(cfg, layer_params["moe"], normed)
-        else:
-            ff = _mlp(cfg, layer_params["mlp"], normed)
-        return h + ff, (k_c, v_c)
+        out, _aux = block_combine(cfg, layer_params, x, h_in, attn_out,
+                                  moe_fn)
+        return out, (k_c, v_c)
 
     x, (k_new, v_new) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
@@ -627,11 +645,11 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
         "attn": attn,
         "ln1": {"scale": spec(None, None)},
     }
-    if not cfg.parallel_block:
+    if cfg.has_ln2:
         layers["ln2"] = {"scale": spec(None, None)}
-    if cfg.norm == "layernorm" and cfg.use_bias:
+    if cfg.ln_bias:
         layers["ln1"]["bias"] = spec(None, None)
-        if not cfg.parallel_block:
+        if cfg.has_ln2:
             layers["ln2"]["bias"] = spec(None, None)
 
     if cfg.num_experts:
@@ -664,7 +682,7 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
         "layers": layers,
         "final_norm": {"scale": spec(None)},
     }
-    if cfg.norm == "layernorm" and cfg.use_bias:
+    if cfg.ln_bias:
         specs["final_norm"]["bias"] = spec(None)
     if cfg.pos_emb == "learned":
         specs["embed"]["pos"] = spec(None, fsdp)

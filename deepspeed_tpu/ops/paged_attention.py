@@ -44,24 +44,39 @@ def init_arena(num_layers: int, kv_heads: int, num_blocks: int,
                block_size: int, head_dim: int, dtype=jnp.bfloat16):
     """Paged KV arena with one extra trash block per layer.
 
-    Returns {"k": A, "v": A} with A: [L, kvh, num_blocks+1, bs, dh].
+    Returns {"k": A, "v": A} with A: [kvh, L*(num_blocks+1), bs, dh] —
+    ONE flat block pool for all layers (layer l's logical block b lives at
+    l*(num_blocks+1)+b; see :func:`layer_page_offset`). Flat so the
+    engine's layer scan can thread the WHOLE arena as a carry and update
+    it in place — a per-layer stacked arena would ride the scan as
+    xs/ys, which cannot alias, forcing XLA to copy the full (multi-GB)
+    arena every decode step.
     """
-    shape = (num_layers, kv_heads, num_blocks + 1, block_size, head_dim)
+    shape = (kv_heads, num_layers * (num_blocks + 1), block_size, head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def layer_page_offset(layer: jax.Array, num_blocks: int) -> jax.Array:
+    """Absolute block id offset of ``layer``'s region in the flat pool."""
+    return layer * (num_blocks + 1)
 
 
 def write_kv(arena_k: jax.Array, arena_v: jax.Array, k: jax.Array,
              v: jax.Array, page_table: jax.Array, starts: jax.Array,
-             counts: jax.Array):
-    """Scatter a ragged chunk of new KV into one layer's arena.
+             counts: jax.Array, trash_block=None):
+    """Scatter a ragged chunk of new KV into the arena.
 
-    arena_k/arena_v: [kvh, nb+1, bs, dh]; k/v: [n, c, kvh, dh] new tokens
-    (row i valid for j < counts[i]); page_table: [n, mb] physical block ids
-    (padded entries may be anything — padded tokens route to trash);
-    starts: [n] tokens already in KV per sequence.
+    arena_k/arena_v: [kvh, NB, bs, dh] (one layer's region of the flat
+    pool, or the whole pool with absolute page-table ids); k/v:
+    [n, c, kvh, dh] new tokens (row i valid for j < counts[i]);
+    page_table: [n, mb] physical block ids (padded entries may be
+    anything — padded tokens route to ``trash_block``, default the pool's
+    last block); starts: [n] tokens already in KV per sequence.
     """
     kvh, nbp1, bs, dh = arena_k.shape
     n, c, _, _ = k.shape
+    if trash_block is None:
+        trash_block = nbp1 - 1
     j = jnp.arange(c, dtype=jnp.int32)[None, :]                    # [1, c]
     pos = starts[:, None] + j                                      # [n, c]
     logical = pos // bs                                            # [n, c]
@@ -69,7 +84,7 @@ def write_kv(arena_k: jax.Array, arena_v: jax.Array, k: jax.Array,
     phys = jnp.take_along_axis(page_table, jnp.minimum(
         logical, page_table.shape[1] - 1), axis=1)                 # [n, c]
     valid = j < counts[:, None]
-    phys = jnp.where(valid, phys, nbp1 - 1)                        # → trash
+    phys = jnp.where(valid, phys, trash_block)                     # → trash
     bi = phys.reshape(-1)
     oi = offset.reshape(-1)
     k_rows = k.reshape(n * c, kvh, dh).transpose(1, 0, 2)          # [kvh,nc,dh]
@@ -123,62 +138,90 @@ def paged_attention_xla(q: jax.Array, arena_k: jax.Array,
 # Pallas kernel (decode / short-chunk path)
 # ---------------------------------------------------------------------------
 
-def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_ref, v_ref,
-                  o_ref, acc_ref, m_ref, l_ref, *, block_size: int,
-                  chunk: int, scale: float):
-    """Grid (n_seq, kvh, mb). Online softmax accumulated across the page
-    (last, sequential) grid dimension in VMEM scratch.
+def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_hbm, v_hbm,
+                  o_ref, k_buf, v_buf, sem_k, sem_v, *, block_size: int,
+                  chunk: int, scale: float, mb: int):
+    """Grid (n_seq, kvh): ONE program per (sequence, kv head) that walks
+    this sequence's pages with double-buffered manual DMAs from the
+    HBM-resident arena.
 
-    q_ref block: [1, 1, groups*chunk, dh] (rows = g*chunk + j);
-    k_ref/v_ref block: [1, 1, block_size, dh] — the physical block chosen
-    by the prefetched page table in the index map.
+    A (seq, head, page) grid would be thousands of sequential tiny
+    programs per layer (measured 310 ms vs 1.5 ms per 1B-model decode
+    step); here pages are an in-kernel ``fori_loop`` with the next page's
+    DMA in flight while the current one computes — the reference
+    blocked_flash/paged-KV structure.
+
+    q_ref block: [1, 1, rows, dh] (row = g*chunk + j); k_hbm/v_hbm: the
+    FULL arena [kvh, NB, bs, dh] left in ANY/HBM memory space; k_buf/
+    v_buf: [2, bs, dh] VMEM double buffers.
     """
     s_idx = pl.program_id(0)
-    b = pl.program_id(2)
-    nb = pl.num_programs(2)
+    kh = pl.program_id(1)
     rows = q_ref.shape[2]
-
-    @pl.when(b == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-
     start = starts_ref[s_idx]
     ctx = start + counts_ref[s_idx]
+    npages = jnp.minimum(lax.div(ctx + block_size - 1,
+                                 jnp.int32(block_size)), mb)
 
-    @pl.when(b * block_size < ctx)
-    def _compute():
+    def copy_in(page_i, slot):
+        page = pt_ref[s_idx, page_i]
+        pltpu.make_async_copy(k_hbm.at[kh, page], k_buf.at[slot],
+                              sem_k.at[slot]).start()
+        pltpu.make_async_copy(v_hbm.at[kh, page], v_buf.at[slot],
+                              sem_v.at[slot]).start()
+
+    @pl.when(npages > 0)
+    def _run():
+        copy_in(0, 0)
         q = q_ref[0, 0]                                     # [rows, dh]
-        k_blk = k_ref[0, 0]                                 # [bs, dh]
-        v_blk = v_ref[0, 0]
-        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        r = lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
-        j = lax.rem(r, chunk)                               # query offset
-        qpos = start + j
-        kpos = b * block_size + \
-            lax.broadcasted_iota(jnp.int32, (rows, block_size), 1)
-        s = jnp.where((kpos <= qpos) & (kpos < ctx), s, _NEG_INF)
 
-        m_prev = m_ref[...]
-        l_prev = l_ref[...]
-        blk_max = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, blk_max)
-        p = jnp.exp(s - m_new[:, None])
-        alive = m_new > _NEG_INF / 2
-        p = jnp.where(alive[:, None], p, 0.0)
-        corr = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
-        acc_ref[...] = acc_ref[...] * corr[:, None] + lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1)
-        m_ref[...] = m_new
+        def body(b, carry):
+            acc, m_prev, l_prev = carry
+            slot = lax.rem(b, 2)
 
-    @pl.when(b == nb - 1)
-    def _finalize():
-        l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+            @pl.when(b + 1 < npages)
+            def _prefetch():
+                copy_in(b + 1, lax.rem(b + 1, 2))
+
+            pltpu.make_async_copy(k_hbm.at[kh, 0], k_buf.at[slot],
+                                  sem_k.at[slot]).wait()
+            pltpu.make_async_copy(v_hbm.at[kh, 0], v_buf.at[slot],
+                                  sem_v.at[slot]).wait()
+            k_blk = k_buf[slot]
+            v_blk = v_buf[slot]
+            s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            r = lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
+            j = lax.rem(r, chunk)                           # query offset
+            qpos = start + j
+            kpos = b * block_size + \
+                lax.broadcasted_iota(jnp.int32, (rows, block_size), 1)
+            s = jnp.where((kpos <= qpos) & (kpos < ctx), s, _NEG_INF)
+
+            blk_max = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, blk_max)
+            p = jnp.exp(s - m_new[:, None])
+            # float mask arithmetic, NOT a bool broadcast: Mosaic can't
+            # insert a minor dim on i1 vectors
+            alive = (m_new > _NEG_INF / 2).astype(jnp.float32)
+            p = p * alive[:, None]
+            corr = jnp.exp(m_prev - m_new) * alive
+            acc = acc * corr[:, None] + lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            l = l_prev * corr + jnp.sum(p, axis=1)
+            return acc, m_new, l
+
+        acc0 = jnp.zeros((rows, q_ref.shape[3]), jnp.float32)
+        m0 = jnp.full((rows,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((rows,), jnp.float32)
+        acc, m, l = lax.fori_loop(0, npages, body, (acc0, m0, l0))
+        l = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    @pl.when(npages == 0)
+    def _empty():
+        o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
 
 
 def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
@@ -187,11 +230,10 @@ def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
                     ) -> jax.Array:
     """Pallas paged attention. Same contract as :func:`paged_attention_xla`.
 
-    The page table is a scalar-prefetch operand: each (seq, head, page)
-    program's K/V DMA reads block ``page_table[seq, page]`` directly from
-    the arena — no HBM gather. Dead pages (beyond a sequence's context
-    length) skip compute via ``pl.when``; their table entries must point at
-    a real block (e.g. the trash block) so the DMA stays in bounds.
+    The page table is a scalar-prefetch operand read INSIDE the kernel to
+    drive manual double-buffered DMAs from the HBM arena — no HBM gather,
+    no per-page grid step. Dead pages (beyond a sequence's context length)
+    are skipped by the dynamic in-kernel loop bound.
     """
     kvh, nbp1, bs, dh = arena_k.shape
     n, c, h, _ = q.shape
@@ -203,9 +245,9 @@ def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
     qk = q.reshape(n, c, kvh, groups, dh).transpose(0, 2, 3, 1, 4) \
         .reshape(n, kvh, rows, dh)
 
-    grid = (n, kvh, mb)
+    grid = (n, kvh)
     kernel = functools.partial(_paged_kernel, block_size=bs, chunk=c,
-                               scale=1.0 / math.sqrt(dh))
+                               scale=1.0 / math.sqrt(dh), mb=mb)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -213,21 +255,18 @@ def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, rows, dh),
-                             lambda s, kh, b, pt, st, ct: (s, kh, 0, 0)),
-                pl.BlockSpec((1, 1, bs, dh),
-                             lambda s, kh, b, pt, st, ct:
-                             (kh, pt[s, b], 0, 0)),
-                pl.BlockSpec((1, 1, bs, dh),
-                             lambda s, kh, b, pt, st, ct:
-                             (kh, pt[s, b], 0, 0)),
+                             lambda s, kh, pt, st, ct: (s, kh, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, rows, dh),
-                lambda s, kh, b, pt, st, ct: (s, kh, 0, 0)),
+                lambda s, kh, pt, st, ct: (s, kh, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((rows, dh), jnp.float32),
-                pltpu.VMEM((rows,), jnp.float32),
-                pltpu.VMEM((rows,), jnp.float32),
+                pltpu.VMEM((2, bs, dh), arena_k.dtype),
+                pltpu.VMEM((2, bs, dh), arena_v.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((n, kvh, rows, dh), q.dtype),

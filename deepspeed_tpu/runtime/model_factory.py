@@ -47,6 +47,15 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig):
     sp = ds_cfg.sequence_parallel
     impl = ds_cfg.attention_impl
     if impl in _ATTENTION_REGISTRY:
+        if sp.size > 1:
+            # the builtin impls get ring/Ulysses wrapping below; silently
+            # running a raw custom impl on sequence shards would compute
+            # wrong attention — make the combination an explicit error
+            raise ValueError(
+                f"attention_impl '{impl}' (registered) does not compose "
+                f"with sequence_parallel.size={sp.size}: custom impls "
+                f"must handle the 'seq' axis themselves — register an "
+                f"SP-aware fn or use a builtin impl")
         return _ATTENTION_REGISTRY[impl]
     if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
         raise ValueError(

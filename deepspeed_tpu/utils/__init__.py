@@ -3,3 +3,21 @@ from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTime
 
 __all__ = ["logger", "log_dist", "print_rank_0",
            "SynchronizedWallClockTimer", "ThroughputTimer"]
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Reference deepspeed/utils see_memory_usage: log device memory
+    telemetry at checkpoints in the code. TPU numbers come from the
+    accelerator L0 memory_stats (device HBM via PJRT)."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.utils.logging import logger
+    if not force:
+        return
+    stats = get_accelerator().memory_stats() or {}
+    used = stats.get("bytes_in_use", stats.get("bytes_used", 0))
+    peak = stats.get("peak_bytes_in_use", used)
+    limit = stats.get("bytes_limit", 0)
+    logger.info(
+        f"{message} | HBM used {used / 2**30:.2f} GiB "
+        f"(peak {peak / 2**30:.2f}"
+        + (f" / limit {limit / 2**30:.2f}" if limit else "") + " GiB)")

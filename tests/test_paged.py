@@ -17,7 +17,7 @@ from deepspeed_tpu.parallel.mesh import build_mesh
 def _random_arena_state(rng, kvh=2, nb=8, bs=16, dh=128, n=3, mb=4):
     """Build an arena holding random contexts for n sequences."""
     arena = pa.init_arena(1, kvh, nb, bs, dh, jnp.float32)
-    ak, av = arena["k"][0], arena["v"][0]
+    ak, av = arena["k"], arena["v"]
     pt = np.full((n, mb), nb, np.int32)
     ctxs = [5, 30, 47]                      # straddle block boundaries
     free = list(range(nb))
@@ -89,7 +89,7 @@ def test_trash_block_isolation():
     """Padded-token writes must land in the trash block, never a live one."""
     kvh, nb, bs, dh = 1, 4, 16, 128
     arena = pa.init_arena(1, kvh, nb, bs, dh, jnp.float32)
-    ak, av = arena["k"][0], arena["v"][0]
+    ak, av = arena["k"], arena["v"]
     pt = np.array([[0, 1]], np.int32)
     k = jnp.ones((1, 4, kvh, dh), jnp.float32) * 7.0
     v = jnp.ones((1, 4, kvh, dh), jnp.float32) * 7.0

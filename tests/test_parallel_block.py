@@ -21,7 +21,9 @@ def test_parallel_block_forward_and_cache(cfg_fn, devices):
     cfg = cfg_fn("tiny", max_seq_len=64, vocab_size=256)
     assert cfg.parallel_block
     params = init_params(cfg, jax.random.PRNGKey(0))
-    assert "ln2" not in params["layers"]
+    # 1-norm variants (falcon-7b family) drop ln2; 2-norm variants
+    # (neox/pythia, falcon-40b) keep a separate post_attention norm
+    assert ("ln2" in params["layers"]) == (cfg.parallel_block_norms == 2)
     tok = jnp.asarray(np.random.default_rng(0).integers(
         0, 256, size=(2, 12), dtype=np.int32))
     full = forward(cfg, params, tok)
@@ -95,3 +97,35 @@ def test_parallel_block_ragged_inference(devices):
     got = v2.generate([prompt], max_new_tokens=5)[0]
     ref = v1.generate(prompt[None], max_new_tokens=5)[0]
     np.testing.assert_array_equal(got, ref[:12])
+
+
+def test_falcon_ln_bias_without_linear_bias():
+    """Falcon: LayerNorms keep biases while linears drop them."""
+    cfg = falcon_config("tiny")
+    from deepspeed_tpu.models.transformer import init_params
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    assert "bias" in p["layers"]["ln1"]          # LN bias present
+    assert "bq" not in p["layers"]["attn"]       # linear bias absent
+    assert cfg.ln_bias and not cfg.use_bias
+
+
+def test_export_rejects_parallel_block(tmp_path):
+    from deepspeed_tpu.models.hf_loader import export_hf_checkpoint
+    from deepspeed_tpu.models.transformer import init_params
+    cfg = falcon_config("tiny")
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="parallel"):
+        export_hf_checkpoint(cfg, p, str(tmp_path))
+
+
+def test_registered_attention_rejects_sp(devices):
+    from deepspeed_tpu.config import DeepSpeedTPUConfig
+    from deepspeed_tpu.runtime.model_factory import (
+        register_attention_impl, select_attention)
+    register_attention_impl("raw_impl", lambda q, k, v, **kw: q)
+    cfg = DeepSpeedTPUConfig.from_any(
+        {"train_micro_batch_size_per_gpu": 1,
+         "attention_impl": "raw_impl",
+         "sequence_parallel": {"size": 2}})
+    with pytest.raises(ValueError, match="does not compose"):
+        select_attention(cfg)
