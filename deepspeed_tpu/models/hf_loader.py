@@ -30,7 +30,7 @@ Params = Any
 # config mapping
 # ---------------------------------------------------------------------------
 
-_FAMILIES = ("llama", "mistral", "mixtral", "qwen2")
+_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox")
 
 
 def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
@@ -39,6 +39,22 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
     if mt not in _FAMILIES:
         raise ValueError(f"unsupported model_type '{mt}'; "
                          f"supported: {_FAMILIES}")
+    if mt == "gpt_neox":
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", pos_emb="rope",
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            rotary_pct=float(hf.get("rotary_pct", 0.25)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            use_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            parallel_block=bool(hf.get("use_parallel_residual", True)),
+            parallel_block_norms=2)
     kw = dict(
         hidden_size=hf["hidden_size"],
         num_layers=hf["num_hidden_layers"],
@@ -62,7 +78,32 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
     return DecoderConfig(**kw)
 
 
+def _is_neox_layout(cfg: DecoderConfig) -> bool:
+    """NeoX/Pythia family marker (covers use_parallel_residual False too:
+    sequential NeoX still has the layernorm+bias+gelu+rope layout that the
+    llama mapping can't express)."""
+    return (cfg.norm == "layernorm" and cfg.pos_emb == "rope"
+            and cfg.use_bias and cfg.activation == "gelu")
+
+
 def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
+    if _is_neox_layout(cfg):
+        return {
+            "model_type": "gpt_neox",
+            "architectures": ["GPTNeoXForCausalLM"],
+            "hidden_size": cfg.hidden_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "intermediate_size": cfg.ffn_size,
+            "vocab_size": cfg.vocab_size,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rotary_emb_base": cfg.rope_theta,
+            "rotary_pct": cfg.rotary_pct,
+            "layer_norm_eps": cfg.norm_eps,
+            "use_parallel_residual": cfg.parallel_block,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            "torch_dtype": "float32",
+        }
     hf = {
         "model_type": "mixtral" if cfg.num_experts else "llama",
         "architectures": ["MixtralForCausalLM" if cfg.num_experts
@@ -124,6 +165,8 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
     cfg = config_from_hf(hf_cfg)
     get, names = _reader(model_dir)
     L = cfg.num_layers
+    if hf_cfg.get("model_type") == "gpt_neox":
+        return cfg, _load_neox(cfg, get, dtype)
 
     def T(name):
         return np.ascontiguousarray(get(name).astype(dtype).T)
@@ -187,6 +230,68 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
     return cfg, params
 
 
+def _load_neox(cfg: DecoderConfig, get, dtype) -> Params:
+    """GPT-NeoX/Pythia layout: fused query_key_value with PER-HEAD
+    interleaving ([heads, 3, dh] on the out dim), separate input/
+    post_attention norms, biases everywhere."""
+    L, H, dh, D = (cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                   cfg.hidden_size)
+    p = "gpt_neox.layers.{}."
+
+    def split_qkv_w(i):
+        w = get(p.format(i) + "attention.query_key_value.weight")
+        w = w.astype(dtype).reshape(H, 3, dh, D)
+        # → our [in, out] einsum layout, out = head-major × dh
+        return tuple(np.ascontiguousarray(
+            w[:, j].reshape(H * dh, D).T) for j in range(3))
+
+    def split_qkv_b(i):
+        b = get(p.format(i) + "attention.query_key_value.bias")
+        b = b.astype(dtype).reshape(H, 3, dh)
+        return tuple(b[:, j].reshape(-1) for j in range(3))
+
+    qw, kw, vw = zip(*(split_qkv_w(i) for i in range(L)))
+    qb, kb, vb = zip(*(split_qkv_b(i) for i in range(L)))
+
+    def stack(fmt):
+        return np.stack([get(fmt.format(i)).astype(dtype)
+                         for i in range(L)])
+
+    def stackT(fmt):
+        return np.stack([np.ascontiguousarray(
+            get(fmt.format(i)).astype(dtype).T) for i in range(L)])
+
+    layers = {
+        "attn": {
+            "wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+            "wo": stackT(p + "attention.dense.weight"),
+            "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+            "bo": stack(p + "attention.dense.bias"),
+        },
+        "ln1": {"scale": stack(p + "input_layernorm.weight"),
+                "bias": stack(p + "input_layernorm.bias")},
+        "ln2": {"scale": stack(p + "post_attention_layernorm.weight"),
+                "bias": stack(p + "post_attention_layernorm.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.dense_h_to_4h.weight"),
+            "bi": stack(p + "mlp.dense_h_to_4h.bias"),
+            "wo": stackT(p + "mlp.dense_4h_to_h.weight"),
+            "bo": stack(p + "mlp.dense_4h_to_h.bias"),
+        },
+    }
+    params: Params = {
+        "embed": {"tokens": get("gpt_neox.embed_in.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("gpt_neox.final_layer_norm.weight").astype(dtype),
+            "bias": get("gpt_neox.final_layer_norm.bias").astype(dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.ascontiguousarray(
+            get("embed_out.weight").astype(dtype).T)
+    return params
+
+
 def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
                          out_dir: str) -> None:
     """Write the pytree back as an HF-layout safetensors checkpoint
@@ -194,11 +299,13 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     here load in transformers."""
     import jax
     from safetensors.numpy import save_file
+    if _is_neox_layout(cfg):
+        return _export_neox(cfg, params, out_dir)
     if cfg.parallel_block:
         raise NotImplementedError(
-            "export_hf_checkpoint maps the llama-family layout only; "
-            "parallel-residual models (falcon/gptneox presets) need their "
-            "own key mapping — not implemented yet")
+            "export_hf_checkpoint supports llama-family and GPT-NeoX "
+            "layouts; other parallel-residual variants (falcon) need "
+            "their own key mapping — not implemented yet")
 
     os.makedirs(out_dir, exist_ok=True)
     host = jax.tree.map(
@@ -241,6 +348,56 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
                 np.ascontiguousarray(m["wi"][i].T)
             out[p.format(i) + "mlp.down_proj.weight"] = \
                 np.ascontiguousarray(m["wo"][i].T)
+    save_file(out, os.path.join(out_dir, "model.safetensors"),
+              metadata={"format": "pt"})
+    with open(os.path.join(out_dir, "config.json"), "w") as fh:
+        json.dump(config_to_hf(cfg), fh, indent=2)
+
+
+def _export_neox(cfg: DecoderConfig, params: Params, out_dir: str) -> None:
+    """Reverse of _load_neox (re-interleaves the fused qkv)."""
+    import jax
+    from safetensors.numpy import save_file
+    os.makedirs(out_dir, exist_ok=True)
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x), np.float32), params)
+    H, dh, D = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "gpt_neox.embed_in.weight": host["embed"]["tokens"],
+        "gpt_neox.final_layer_norm.weight": host["final_norm"]["scale"],
+        "gpt_neox.final_layer_norm.bias": host["final_norm"]["bias"],
+    }
+    if not cfg.tie_embeddings:
+        out["embed_out.weight"] = np.ascontiguousarray(host["lm_head"].T)
+    lyr = host["layers"]
+    p = "gpt_neox.layers.{}."
+    for i in range(cfg.num_layers):
+        a = lyr["attn"]
+        # [D, H*dh] per proj → fused [H, 3, dh, D] row-major out
+        fused_w = np.stack(
+            [a[k][i].T.reshape(H, dh, D) for k in ("wq", "wk", "wv")],
+            axis=1).reshape(3 * H * dh, D)
+        fused_b = np.stack(
+            [a[k][i].reshape(H, dh) for k in ("bq", "bk", "bv")],
+            axis=1).reshape(-1)
+        pi = p.format(i)
+        out[pi + "attention.query_key_value.weight"] = \
+            np.ascontiguousarray(fused_w)
+        out[pi + "attention.query_key_value.bias"] = fused_b
+        out[pi + "attention.dense.weight"] = \
+            np.ascontiguousarray(a["wo"][i].T)
+        out[pi + "attention.dense.bias"] = a["bo"][i]
+        out[pi + "input_layernorm.weight"] = lyr["ln1"]["scale"][i]
+        out[pi + "input_layernorm.bias"] = lyr["ln1"]["bias"][i]
+        out[pi + "post_attention_layernorm.weight"] = lyr["ln2"]["scale"][i]
+        out[pi + "post_attention_layernorm.bias"] = lyr["ln2"]["bias"][i]
+        m = lyr["mlp"]
+        out[pi + "mlp.dense_h_to_4h.weight"] = \
+            np.ascontiguousarray(m["wi"][i].T)
+        out[pi + "mlp.dense_h_to_4h.bias"] = m["bi"][i]
+        out[pi + "mlp.dense_4h_to_h.weight"] = \
+            np.ascontiguousarray(m["wo"][i].T)
+        out[pi + "mlp.dense_4h_to_h.bias"] = m["bo"][i]
     save_file(out, os.path.join(out_dir, "model.safetensors"),
               metadata={"format": "pt"})
     with open(os.path.join(out_dir, "config.json"), "w") as fh:

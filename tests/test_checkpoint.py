@@ -168,3 +168,26 @@ def test_incomplete_multiprocess_checkpoint_detected(tmp_path, devices):
         store.load_checkpoint(
             str(tmp_path), "t", {"params": {"w": np.zeros(8, np.float32)}},
             {"params": {"w": None}})
+
+
+def test_dstpu_ckpt_cli(tmp_path, devices):
+    """bin/dstpu_ckpt consolidates a sharded checkpoint to fp32 offline
+    (reference utils/zero_to_fp32.py CLI)."""
+    import subprocess
+    import sys
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    eng, *_ = initialize(model=model, config=_cfg(2),
+                         rng=jax.random.PRNGKey(1))
+    eng.train_batch(iter(_batches(1)))
+    eng.save_checkpoint(str(tmp_path / "ck"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.getcwd(), "bin", "dstpu_ckpt"),
+         str(tmp_path / "ck"), str(tmp_path / "fp32.npz")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.getcwd()})
+    assert out.returncode == 0, out.stderr[-800:]
+    data = np.load(tmp_path / "fp32.npz")
+    assert "embed.tokens" in data.files
+    assert data["embed.tokens"].dtype == np.float32
