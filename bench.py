@@ -19,6 +19,7 @@ Prints exactly ONE JSON line to stdout.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -83,7 +84,9 @@ def main() -> None:
         "bf16": {"enabled": bool(on_tpu)},
         "gradient_clipping": 1.0,
         "activation_checkpointing": {
-            "policy": "save_attn_out" if on_tpu else "none"},
+            "policy": os.environ.get("DSTPU_BENCH_REMAT",
+                                     "save_attn_out" if on_tpu
+                                     else "none")},
         "steps_per_print": 1000,
     }
     engine, *_ = ds.initialize(model=model, config=config,

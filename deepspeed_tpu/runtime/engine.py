@@ -659,6 +659,51 @@ class DeepSpeedTPUEngine:
                 "sanity check failed: dataloader batches differ across "
                 "processes (reference engine.py:520 check)")
 
+    def eval_batch(self, data_iter: Optional[Iterator[Batch]] = None
+                   ) -> jax.Array:
+        """Forward-only loss over one global batch — no gradients, no
+        state change (reference PipelineEngine.eval_batch / engine eval
+        usage). Works in every engine mode, including ZeRO++ flat storage
+        (params unflattened on the fly) and pipeline (GPipe loss fn)."""
+        if self.offload_enabled:
+            self._drain_host_step()     # overlap mode: apply the pending
+            #                             update or we'd eval stale weights
+        gas = int(self.config.gradient_accumulation_steps)
+        it = data_iter if data_iter is not None else \
+            self._own_data_iterator()
+        micros = [next(it) for _ in range(gas)]
+        batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
+        if self.config.check_nan_inf:
+            self._check_batch_consistency(micros)
+        batch = self._place_stacked_batch(batch)
+        # derive an eval key WITHOUT advancing the training rng stream —
+        # eval must not perturb training reproducibility
+        sub = jax.random.fold_in(self._rng, self.global_steps)
+        if getattr(self, "_eval_step", None) is None:
+            if self.model.pipeline_loss_fn is not None:
+                def eval_fn(params, batch, rng):
+                    return self.model.pipeline_loss_fn(params, batch, rng)
+            else:
+                def eval_fn(params, batch, rng):
+                    def micro(carry, mb):
+                        r = carry
+                        r, s = jax.random.split(r)
+                        out = self.model.loss_fn(self._eval_params(params),
+                                                 mb, s)
+                        loss = out[0] if isinstance(out, tuple) else out
+                        return r, loss
+                    _, losses = jax.lax.scan(micro, rng, batch)
+                    return jnp.mean(losses)
+            self._eval_step = jax.jit(eval_fn)
+        return self._eval_step(self.params, batch, sub)
+
+    def _eval_params(self, params):
+        """Engine-mode params view for evaluation (ZeRO++ stores flat)."""
+        if getattr(self, "_zeropp_enabled", False):
+            layout = self._zeropp_layout
+            return layout.unflatten_device(params[:layout.total])
+        return params
+
     def _apply_host_result(self, result) -> Dict[str, Any]:
         """Upload the host step's flat master (ONE device_put + jitted
         unflatten) and fold in overflow/loss-scale bookkeeping."""

@@ -255,3 +255,31 @@ def test_custom_attention_registry(devices):
         0, 128, size=(8, 32), dtype=np.int32)}
     loss = float(eng.train_batch(iter([batch])))
     assert np.isfinite(loss) and calls     # custom impl was traced
+
+
+def test_eval_batch(devices):
+    """eval_batch: forward-only loss, no state change, matches the value
+    train_batch would see pre-update."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.runtime.engine import initialize
+    build_mesh(data=8)
+    eng, *_ = initialize(
+        model=gpt2_config("tiny", max_seq_len=32, vocab_size=128),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2}},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batches = [{"input_ids": rng.integers(0, 128, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(2)]
+    steps0 = eng.global_steps
+    l_eval = float(eng.eval_batch(iter(batches)))
+    assert eng.global_steps == steps0               # no state change
+    l_eval2 = float(eng.eval_batch(iter(batches)))
+    np.testing.assert_allclose(l_eval, l_eval2, rtol=1e-6)  # deterministic-ish
+    l_train = float(eng.train_batch(iter(batches)))
+    np.testing.assert_allclose(l_train, l_eval, rtol=1e-4, atol=1e-4)
+    # after the update the eval loss moves
+    assert abs(float(eng.eval_batch(iter(batches))) - l_eval) > 1e-5
