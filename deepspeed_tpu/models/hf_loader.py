@@ -83,7 +83,8 @@ def _is_neox_layout(cfg: DecoderConfig) -> bool:
     sequential NeoX still has the layernorm+bias+gelu+rope layout that the
     llama mapping can't express)."""
     return (cfg.norm == "layernorm" and cfg.pos_emb == "rope"
-            and cfg.use_bias and cfg.activation == "gelu")
+            and cfg.use_bias and cfg.activation == "gelu"
+            and cfg.has_ln2)   # 1-norm parallel models (phi) are NOT neox
 
 
 def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:

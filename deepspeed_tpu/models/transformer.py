@@ -44,7 +44,7 @@ class DecoderConfig:
     intermediate_size: Optional[int] = None  # None => 4*hidden (gelu) / llama default
     max_seq_len: int = 1024
     norm: str = "layernorm"                # 'layernorm' | 'rmsnorm'
-    activation: str = "gelu"               # 'gelu' | 'silu_glu'
+    activation: str = "gelu"               # 'gelu' | 'silu_glu' | 'relu'
     pos_emb: str = "learned"               # 'learned' | 'rope'
     rope_theta: float = 10000.0
     use_bias: bool = True
@@ -241,7 +241,8 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
         hidden = jnp.einsum("btd,dh->bth", x, p["wi"])
         if "bi" in p:
             hidden = hidden + p["bi"]
-        hidden = jax.nn.gelu(hidden)
+        hidden = jax.nn.relu(hidden) if cfg.activation == "relu" \
+            else jax.nn.gelu(hidden)
     out = jnp.einsum("bth,hd->btd", hidden, p["wo"])
     if "bo" in p:
         out = out + p["bo"]

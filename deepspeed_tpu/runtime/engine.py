@@ -668,9 +668,13 @@ class DeepSpeedTPUEngine:
         if self.offload_enabled:
             self._drain_host_step()     # overlap mode: apply the pending
             #                             update or we'd eval stale weights
+        if data_iter is None:
+            raise ValueError(
+                "eval_batch needs an explicit data_iter — consuming the "
+                "engine's training iterator would silently skip training "
+                "samples (reference eval_batch takes its own loader)")
         gas = int(self.config.gradient_accumulation_steps)
-        it = data_iter if data_iter is not None else \
-            self._own_data_iterator()
+        it = data_iter
         micros = [next(it) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
         if self.config.check_nan_inf:

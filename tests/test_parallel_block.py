@@ -129,3 +129,36 @@ def test_registered_attention_rejects_sp(devices):
          "sequence_parallel": {"size": 2}})
     with pytest.raises(ValueError, match="does not compose"):
         select_attention(cfg)
+
+
+@pytest.mark.parametrize("preset", ["phi", "opt"])
+def test_extra_families_train_and_decode(preset, devices):
+    """GPT-J/Phi/OPT presets: train a few steps and verify cached decode
+    matches the full forward (covers relu MLP, shared-norm parallel
+    blocks, partial rotary variants)."""
+    from deepspeed_tpu.models import opt_config, phi_config
+    from deepspeed_tpu.runtime.engine import initialize
+    cfg_fn = {"phi": phi_config, "opt": opt_config}[preset]
+    build_mesh(data=8)
+    cfg = cfg_fn("tiny", max_seq_len=32, vocab_size=128)
+    eng, *_ = initialize(
+        model=cfg,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 2}},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    losses = [float(eng.train_batch(iter([batch]))) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    tok = jnp.asarray(rng.integers(0, 128, size=(1, 10), dtype=np.int32))
+    full = forward(cfg, params, tok)
+    cache = init_kv_cache(cfg, 1, 16, jnp.float32)
+    logits, cache = forward_with_cache(cfg, params, tok[:, :6], cache,
+                                       jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 5]),
+                               rtol=1e-3, atol=1e-3)
