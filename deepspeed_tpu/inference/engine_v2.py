@@ -29,7 +29,8 @@ from deepspeed_tpu.inference.ragged import (DSStateManager, RaggedBatch,
                                             RaggedScheduler)
 from deepspeed_tpu.models.transformer import (DecoderConfig, _mlp, _norm,
                                               block_combine,
-                                              attn_out_project, init_params,
+                                              attn_out_project, embed_tokens,
+                                              init_params,
                                               lm_logits, qkv_project,
                                               rope_table)
 from deepspeed_tpu.ops import paged_attention as pa
@@ -59,16 +60,27 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
     fp32, updated arena). Rows with counts == 0 produce garbage logits the
     caller ignores.
     """
+    if cfg.pos_emb == "alibi":
+        # the paged kernels have no score-bias port; serving BLOOM-class
+        # models needs the v1 cached engine (forward_with_cache applies
+        # alibi internally)
+        raise NotImplementedError(
+            "ragged/paged inference does not support ALiBi models; use "
+            "InferenceEngineTPU (v1 KV-cache path) for BLOOM-class models")
     n, c = tokens.shape
-    x = params["embed"]["tokens"][tokens]
     positions = starts[:, None] + jnp.broadcast_to(
         jnp.arange(c, dtype=jnp.int32)[None], (n, c))
     if cfg.pos_emb == "learned":
         maxpos = params["embed"]["pos"].shape[0]
-        x = x + params["embed"]["pos"][jnp.minimum(positions, maxpos - 1)]
-        sin = cos = jnp.zeros((n, c, 0), x.dtype)
+        emb_pos = jnp.minimum(positions, maxpos - 1)
+        sin = cos = jnp.zeros((n, c, 0), jnp.float32)
     else:
+        emb_pos = positions
         sin, cos = rope_table(cfg, positions)
+    x = embed_tokens(cfg, params["embed"], tokens, emb_pos,
+                     params.get("embed_norm"))
+    if cfg.pos_emb != "rope":
+        sin = cos = jnp.zeros((n, c, 0), x.dtype)
 
     attend = pa.paged_attention if use_pallas else pa.paged_attention_xla
     # per-layer page stride in the FLAT block pool (init_arena docstring:

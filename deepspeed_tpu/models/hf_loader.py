@@ -30,7 +30,8 @@ Params = Any
 # config mapping
 # ---------------------------------------------------------------------------
 
-_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox")
+_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox",
+              "gemma")
 
 
 def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
@@ -75,7 +76,17 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
     if mt == "mixtral":
         kw.update(num_experts=hf["num_local_experts"],
                   num_experts_per_tok=hf.get("num_experts_per_tok", 2))
+    if mt == "gemma":
+        # gemma stores RMSNorm as (1 + w) — folded into `scale` at load —
+        # plus GeGLU, sqrt(d)-scaled embeddings and a decoupled head_dim
+        kw.update(activation="gelu_glu", scale_embeddings=True,
+                  head_dim_override=hf.get("head_dim"),
+                  tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
     return DecoderConfig(**kw)
+
+
+def _is_gemma_layout(cfg: DecoderConfig) -> bool:
+    return cfg.activation == "gelu_glu" and cfg.scale_embeddings
 
 
 def _is_neox_layout(cfg: DecoderConfig) -> bool:
@@ -105,10 +116,23 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             "tie_word_embeddings": cfg.tie_embeddings,
             "torch_dtype": "float32",
         }
+    if not (cfg.norm == "rmsnorm" and cfg.pos_emb == "rope"
+            and cfg.is_glu):
+        raise ValueError(
+            f"config_to_hf: no HF layout for norm={cfg.norm} "
+            f"pos_emb={cfg.pos_emb} activation={cfg.activation}; "
+            f"supported exports: llama/mistral/mixtral/qwen2-like, "
+            f"gemma, gpt_neox")
+    if _is_gemma_layout(cfg):
+        mt = "gemma"
+        arch = ["GemmaForCausalLM"]
+    elif cfg.num_experts:
+        mt, arch = "mixtral", ["MixtralForCausalLM"]
+    else:
+        mt, arch = "llama", ["LlamaForCausalLM"]
     hf = {
-        "model_type": "mixtral" if cfg.num_experts else "llama",
-        "architectures": ["MixtralForCausalLM" if cfg.num_experts
-                          else "LlamaForCausalLM"],
+        "model_type": mt,
+        "architectures": arch,
         "hidden_size": cfg.hidden_size,
         "num_hidden_layers": cfg.num_layers,
         "num_attention_heads": cfg.num_heads,
@@ -121,6 +145,14 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "torch_dtype": "float32",
     }
+    if _is_gemma_layout(cfg):
+        # always explicit: GemmaConfig's DEFAULT head_dim is 256, not
+        # hidden//heads — an omitted key reloads with the wrong shape
+        hf["head_dim"] = cfg.head_dim
+        hf["hidden_act"] = "gelu_pytorch_tanh"
+        hf["hidden_activation"] = "gelu_pytorch_tanh"
+    elif cfg.head_dim_override is not None:
+        hf["head_dim"] = cfg.head_dim_override
     if cfg.num_experts:
         hf["num_local_experts"] = cfg.num_experts
         hf["num_experts_per_tok"] = cfg.num_experts_per_tok
@@ -223,6 +255,10 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         "layers": layers,
         "final_norm": {"scale": get("model.norm.weight").astype(dtype)},
     }
+    if hf_cfg.get("model_type") == "gemma":
+        # HF gemma RMSNorm computes x̂·(1+w); our _norm computes x̂·scale
+        for ln in (layers["ln1"], layers["ln2"], params["final_norm"]):
+            ln["scale"] = ln["scale"] + 1.0
     if not cfg.tie_embeddings:
         params["lm_head"] = T("lm_head.weight")
     logger.info(f"loaded HF checkpoint from {model_dir}: "
@@ -304,13 +340,18 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
         return _export_neox(cfg, params, out_dir)
     if cfg.parallel_block:
         raise NotImplementedError(
-            "export_hf_checkpoint supports llama-family and GPT-NeoX "
-            "layouts; other parallel-residual variants (falcon) need "
-            "their own key mapping — not implemented yet")
+            "export_hf_checkpoint supports llama-family, gemma and "
+            "GPT-NeoX layouts; other parallel-residual variants (falcon) "
+            "need their own key mapping — not implemented yet")
+    cfg_hf = config_to_hf(cfg)   # raises on unsupported layouts
 
     os.makedirs(out_dir, exist_ok=True)
     host = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x), np.float32), params)
+    if cfg_hf["model_type"] == "gemma":   # reverse the (1+w) fold
+        host["final_norm"]["scale"] = host["final_norm"]["scale"] - 1.0
+        for ln in ("ln1", "ln2"):
+            host["layers"][ln]["scale"] = host["layers"][ln]["scale"] - 1.0
     out: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": host["embed"]["tokens"],
         "model.norm.weight": host["final_norm"]["scale"],
@@ -352,7 +393,7 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     save_file(out, os.path.join(out_dir, "model.safetensors"),
               metadata={"format": "pt"})
     with open(os.path.join(out_dir, "config.json"), "w") as fh:
-        json.dump(config_to_hf(cfg), fh, indent=2)
+        json.dump(cfg_hf, fh, indent=2)
 
 
 def _export_neox(cfg: DecoderConfig, params: Params, out_dir: str) -> None:

@@ -44,8 +44,9 @@ class DecoderConfig:
     intermediate_size: Optional[int] = None  # None => 4*hidden (gelu) / llama default
     max_seq_len: int = 1024
     norm: str = "layernorm"                # 'layernorm' | 'rmsnorm'
-    activation: str = "gelu"               # 'gelu' | 'silu_glu' | 'relu'
-    pos_emb: str = "learned"               # 'learned' | 'rope'
+    #: 'gelu' | 'relu' | 'silu_glu' (Llama SwiGLU) | 'gelu_glu' (Gemma GeGLU)
+    activation: str = "gelu"
+    pos_emb: str = "learned"               # 'learned' | 'rope' | 'alibi'
     rope_theta: float = 10000.0
     use_bias: bool = True
     tie_embeddings: bool = True
@@ -68,6 +69,15 @@ class DecoderConfig:
     num_experts_per_tok: int = 2
     # initializer
     init_std: float = 0.02
+    #: decoupled head dim (Gemma head_dim=256 with H*Dh != hidden);
+    #: None → hidden_size // num_heads
+    head_dim_override: Optional[int] = None
+    #: Gemma2 final_logit_softcapping: logits = c*tanh(logits/c); 0 = off
+    logit_softcap: float = 0.0
+    #: Gemma: scale token embeddings by sqrt(hidden) after lookup
+    scale_embeddings: bool = False
+    #: BLOOM word_embeddings_layernorm: a norm between embed and block 0
+    embed_norm: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -75,7 +85,19 @@ class DecoderConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_heads
+
+    @property
+    def q_dim(self) -> int:
+        """Total query width H*Dh (== hidden_size unless head_dim is
+        decoupled, Gemma-style)."""
+        return self.num_heads * self.head_dim
+
+    @property
+    def is_glu(self) -> bool:
+        return self.activation.endswith("_glu")
 
     @property
     def ln_bias(self) -> bool:
@@ -97,7 +119,7 @@ class DecoderConfig:
     def ffn_size(self) -> int:
         if self.intermediate_size is not None:
             return self.intermediate_size
-        if self.activation == "silu_glu":
+        if self.is_glu:
             return int(8 * self.hidden_size / 3 // 128 * 128) or 4 * self.hidden_size
         return 4 * self.hidden_size
 
@@ -105,8 +127,9 @@ class DecoderConfig:
         """Approximate parameter count (used for MFU accounting)."""
         d, v, l = self.hidden_size, self.vocab_size, self.num_layers
         h = self.ffn_size
-        attn = d * d + 2 * d * self.kv_heads * self.head_dim + d * d
-        if self.activation == "silu_glu":
+        attn = d * self.q_dim + 2 * d * self.kv_heads * self.head_dim \
+            + self.q_dim * d
+        if self.is_glu:
             mlp = 3 * d * h
         else:
             mlp = 2 * d * h
@@ -143,6 +166,25 @@ def _norm_params(cfg: DecoderConfig, shape_prefix=()) -> Params:
     return p
 
 
+def embed_tokens(cfg: DecoderConfig, em: Params, tokens: jax.Array,
+                 positions: jax.Array,
+                 embed_norm: Optional[Params] = None) -> jax.Array:
+    """The ONE home for token-embedding semantics (Gemma sqrt(d) scaling,
+    learned positions, BLOOM word_embeddings_layernorm) — shared by
+    forward_hidden, forward_with_cache, the pipeline stages, and the
+    ragged inference engine so a new embed-affecting knob can't silently
+    diverge between paths."""
+    x = em["tokens"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.hidden_size)
+             ).astype(x.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + em["pos"][positions]
+    if cfg.embed_norm:
+        x = _norm(cfg, embed_norm, x)
+    return x
+
+
 # ---------------------------------------------------------------------------
 # Rotary embeddings
 # ---------------------------------------------------------------------------
@@ -177,13 +219,34 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
 # Attention (reference local path; Ulysses/ring wrap this fn)
 # ---------------------------------------------------------------------------
 
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """Per-head ALiBi slopes (Press et al.; BLOOM build_alibi_tensor
+    convention): geometric sequence 2^(-8/n · i), with the closest
+    power-of-two interpolation for non-power-of-2 head counts."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        s = pow2_slopes(num_heads)
+    else:
+        base = 1 << int(math.floor(math.log2(num_heads)))
+        s = pow2_slopes(base)
+        extra = pow2_slopes(2 * base)[0::2][:num_heads - base]
+        s = s + extra
+    return jnp.asarray(s, jnp.float32)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
-                          q_offset: int = 0) -> jax.Array:
+                          q_offset: int = 0,
+                          alibi: Optional[jax.Array] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     GQA handled by head repetition at the einsum level (no materialized
     repeat). fp32 softmax for numerics; XLA fuses the whole block onto MXU.
+    ``alibi``: per-head slopes [H] → adds slope·(kpos − qpos) to the
+    scores (BLOOM/Press-et-al. linear position bias).
     """
     b, tq, h, dh = q.shape
     _, tk, kvh, _ = k.shape
@@ -192,9 +255,13 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
                         preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(dh)
+    qpos = jnp.arange(tq) + q_offset
+    kpos = jnp.arange(tk)
+    if alibi is not None:
+        rel = (kpos[None, :] - qpos[:, None]).astype(jnp.float32)  # ≤ 0 kept
+        scores = scores + alibi.reshape(kvh, groups)[None, :, :, None, None] \
+            * rel[None, None, None]
     if causal:
-        qpos = jnp.arange(tq) + q_offset
-        kpos = jnp.arange(tk)
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
@@ -203,6 +270,16 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 AttentionFn = Callable[..., jax.Array]
+
+
+def default_attention(cfg: DecoderConfig) -> AttentionFn:
+    """Config-correct plain attention: ALiBi models get their slopes baked
+    in (a bare ``dot_product_attention`` would silently train a
+    position-free BLOOM)."""
+    if cfg.pos_emb == "alibi":
+        return partial(dot_product_attention,
+                       alibi=alibi_slopes(cfg.num_heads))
+    return dot_product_attention
 
 
 def resolve_remat_policy(name: Optional[str]):
@@ -233,10 +310,12 @@ def resolve_remat_policy(name: Optional[str]):
 # ---------------------------------------------------------------------------
 
 def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
-    if cfg.activation == "silu_glu":
+    if cfg.is_glu:
         gate = jnp.einsum("btd,dh->bth", x, p["wg"])
         up = jnp.einsum("btd,dh->bth", x, p["wi"])
-        hidden = jax.nn.silu(gate) * up
+        act = jax.nn.silu(gate) if cfg.activation == "silu_glu" \
+            else jax.nn.gelu(gate, approximate=True)
+        hidden = act * up
     else:
         hidden = jnp.einsum("btd,dh->bth", x, p["wi"])
         if "bi" in p:
@@ -334,19 +413,20 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     d, v, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
     h = cfg.ffn_size
     kd = cfg.kv_heads * cfg.head_dim
+    qd = cfg.q_dim
     keys = jax.random.split(rng, 12)
 
     def w(key, shape, std=cfg.init_std):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
 
     attn = {
-        "wq": w(keys[0], (L, d, d)),
+        "wq": w(keys[0], (L, d, qd)),
         "wk": w(keys[1], (L, d, kd)),
         "wv": w(keys[2], (L, d, kd)),
-        "wo": w(keys[3], (L, d, d), std=cfg.init_std / math.sqrt(2 * L)),
+        "wo": w(keys[3], (L, qd, d), std=cfg.init_std / math.sqrt(2 * L)),
     }
     if cfg.use_bias:
-        attn.update(bq=jnp.zeros((L, d), dtype), bk=jnp.zeros((L, kd), dtype),
+        attn.update(bq=jnp.zeros((L, qd), dtype), bk=jnp.zeros((L, kd), dtype),
                     bv=jnp.zeros((L, kd), dtype), bo=jnp.zeros((L, d), dtype))
 
     layers: Params = {
@@ -364,7 +444,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
             "wo": w(keys[7], (L, E, h, d), std=cfg.init_std / math.sqrt(2 * L)),
         }
     else:
-        if cfg.activation == "silu_glu":
+        if cfg.is_glu:
             layers["mlp"] = {
                 "wg": w(keys[5], (L, d, h)),
                 "wi": w(keys[6], (L, d, h)),
@@ -384,6 +464,8 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
         "layers": layers,
         "final_norm": _norm_params(cfg),
     }
+    if cfg.embed_norm:
+        params["embed_norm"] = _norm_params(cfg)
     if cfg.pos_emb == "learned":
         params["embed"]["pos"] = w(keys[9], (cfg.max_seq_len, d))
     if not cfg.tie_embeddings:
@@ -396,7 +478,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
 # ---------------------------------------------------------------------------
 
 def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
-                   attn_fn: AttentionFn = dot_product_attention,
+                   attn_fn: Optional[AttentionFn] = None,
                    moe_fn: Optional[Callable] = None,
                    positions: Optional[jax.Array] = None,
                    remat_policy: Optional[str] = None
@@ -407,15 +489,17 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     ``jax.checkpoint`` per block (the reference's activation checkpointing
     runtime/activation_checkpointing/ → remat on TPU).
     """
+    if attn_fn is None:
+        attn_fn = default_attention(cfg)
     b, t = tokens.shape
-    x = params["embed"]["tokens"][tokens]  # gather: [B,T,D]
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    if cfg.pos_emb == "learned":
-        x = x + params["embed"]["pos"][positions]
-        sin = cos = jnp.zeros((b, t, 0), x.dtype)
-    else:
+    x = embed_tokens(cfg, params["embed"], tokens, positions,
+                     params.get("embed_norm"))
+    if cfg.pos_emb == "rope":
         sin, cos = rope_table(cfg, positions)
+    else:   # learned: applied in embed; alibi: bias in the attention impl
+        sin = cos = jnp.zeros((b, t, 0), x.dtype)
 
     block = partial(decoder_block, cfg, attn_fn=attn_fn, moe_fn=moe_fn)
 
@@ -431,17 +515,27 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     return x, jnp.sum(aux)
 
 
+def _softcap(cfg: DecoderConfig, logits: jax.Array) -> jax.Array:
+    """Gemma2 final_logit_softcapping: c·tanh(logits/c)."""
+    if cfg.logit_softcap:
+        c = cfg.logit_softcap
+        return c * jnp.tanh(logits / c)
+    return logits
+
+
 def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
     """Final projection: hidden [B,T,D] → logits [B,T,V] fp32."""
     if cfg.tie_embeddings:
-        return jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
-                          preferred_element_type=jnp.float32)
-    return jnp.einsum("btd,dv->btv", x, params["lm_head"],
-                      preferred_element_type=jnp.float32)
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
+                            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                            preferred_element_type=jnp.float32)
+    return _softcap(cfg, logits)
 
 
 def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
-            attn_fn: AttentionFn = dot_product_attention,
+            attn_fn: Optional[AttentionFn] = None,
             moe_fn: Optional[Callable] = None,
             positions: Optional[jax.Array] = None,
             remat_policy: Optional[str] = None,
@@ -500,6 +594,7 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
         else:
             logits = jnp.einsum("bcd,dv->bcv", xc, w,
                                 preferred_element_type=jnp.float32)
+        logits = _softcap(cfg, logits)
         mask = tc != ignore_index
         safe = jnp.where(mask, tc, 0)
         logz = jax.nn.logsumexp(logits, axis=-1)
@@ -561,6 +656,10 @@ def _cached_attention(cfg: DecoderConfig, p: Params, x, sin, cos,
     scores = scores / math.sqrt(dh)
     qpos = cache_len + jnp.arange(t)
     kpos = jnp.arange(tmax)
+    if cfg.pos_emb == "alibi":
+        rel = (kpos[None, :] - qpos[:, None]).astype(jnp.float32)
+        scores = scores + alibi_slopes(cfg.num_heads).reshape(
+            kvh, groups)[None, :, :, None, None] * rel[None, None, None]
     mask = qpos[:, None] >= kpos[None, :]
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
@@ -577,14 +676,14 @@ def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     position [B, V] fp32, updated cache). cache_len: tokens already held.
     """
     b, t = tokens.shape
-    x = params["embed"]["tokens"][tokens]
     positions = cache_len + jnp.broadcast_to(
         jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    if cfg.pos_emb == "learned":
-        x = x + params["embed"]["pos"][positions]
-        sin = cos = jnp.zeros((b, t, 0), x.dtype)
-    else:
+    x = embed_tokens(cfg, params["embed"], tokens, positions,
+                     params.get("embed_norm"))
+    if cfg.pos_emb == "rope":
         sin, cos = rope_table(cfg, positions)
+    else:
+        sin = cos = jnp.zeros((b, t, 0), x.dtype)
 
     def body(carry, layer):
         x = carry
@@ -672,7 +771,7 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
             "wi": spec(None, fsdp, model),
             "wo": spec(None, model, fsdp),
         }
-        if cfg.activation == "silu_glu":
+        if cfg.is_glu:
             mlp["wg"] = spec(None, fsdp, model)
         elif cfg.use_bias:
             mlp.update(bi=spec(None, model), bo=spec(None, None))
@@ -685,6 +784,10 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
     }
     if cfg.ln_bias:
         specs["final_norm"]["bias"] = spec(None)
+    if cfg.embed_norm:
+        specs["embed_norm"] = {"scale": spec(None)}
+        if cfg.ln_bias:
+            specs["embed_norm"]["bias"] = spec(None)
     if cfg.pos_emb == "learned":
         specs["embed"]["pos"] = spec(None, fsdp)
     if not cfg.tie_embeddings:

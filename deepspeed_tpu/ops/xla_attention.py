@@ -26,18 +26,24 @@ _NEG_INF = -1e30
 
 
 def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
-                q_start: int, causal: bool, scale: float) -> jax.Array:
+                q_start: int, causal: bool, scale: float,
+                alibi: Optional[jax.Array] = None) -> jax.Array:
     """One query chunk vs a key prefix.
 
     qg: [B, Cq, KV, G, Dh], k/v: [B, Tk, KV, Dh] → [B, Cq, KV, G, Dh].
+    ``alibi``: per-head slopes [H] (BLOOM linear position bias).
     """
     b, cq, kvh, g, dh = qg.shape
     tk = k.shape[1]
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    qpos = q_start + jnp.arange(cq)
+    kpos = jnp.arange(tk)
+    if alibi is not None:
+        rel = (kpos[None, :] - qpos[:, None]).astype(jnp.float32)
+        scores = scores + alibi.reshape(kvh, g)[None, :, :, None, None] \
+            * rel[None, None, None]
     if causal:
-        qpos = q_start + jnp.arange(cq)
-        kpos = jnp.arange(tk)
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
@@ -47,7 +53,8 @@ def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
 def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = True,
                       q_offset: int = 0,
-                      chunk_q: int = 256) -> jax.Array:
+                      chunk_q: int = 256,
+                      alibi: Optional[jax.Array] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     The q-chunk loop is unrolled at trace time so each chunk attends to a
@@ -59,13 +66,13 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, tq, h, dh = q.shape
     _, tk, kvh, _ = k.shape
     if tq <= chunk_q:
-        return dot_product_attention_ref(q, k, v, causal, q_offset)
+        return dot_product_attention_ref(q, k, v, causal, q_offset, alibi)
     g = h // kvh
     scale = 1.0 / math.sqrt(dh)
     qg = q.reshape(b, tq, kvh, g, dh)
 
     chunk_fn = jax.checkpoint(
-        partial(_chunk_attn, causal=causal, scale=scale),
+        partial(_chunk_attn, causal=causal, scale=scale, alibi=alibi),
         static_argnums=(3,))
 
     # full chunks plus a static remainder chunk for non-multiple lengths
@@ -85,10 +92,10 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.concatenate(outs, axis=1).reshape(b, tq, h, dh)
 
 
-def dot_product_attention_ref(q, k, v, causal=True, q_offset=0):
+def dot_product_attention_ref(q, k, v, causal=True, q_offset=0, alibi=None):
     """Single-chunk fallback (same math, full prefix)."""
     b, tq, h, dh = q.shape
     kvh = k.shape[2]
     qg = q.reshape(b, tq, kvh, h // kvh, dh)
-    out = _chunk_attn(qg, k, v, q_offset, causal, 1.0 / math.sqrt(dh))
+    out = _chunk_attn(qg, k, v, q_offset, causal, 1.0 / math.sqrt(dh), alibi)
     return out.reshape(b, tq, h, dh)

@@ -1,2 +1,5 @@
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
     CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.variable_batch import (  # noqa: F401
+    VariableBatchDataLoader, batch_by_seqlens, scale_lr,
+    variable_batch_lr_schedule)

@@ -1,0 +1,208 @@
+"""Variable batch size + LR scaling for length-heterogeneous corpora.
+
+Reference: ``runtime/data_pipeline/data_sampling/variable_batch_size_and_lr
+.py`` (``batch_by_seqlens``:23, ``scale_lr``:149, ``VariableBatchSizeLR``
+:226) — pack sequences into microbatches holding ~``max_tokens`` tokens
+each ("Attention is all you need" §5.1 batching), then scale the LR per
+step by the realized batch size (linear / sqrt rule).
+
+TPU-first difference: the reference pads each batch to its own max seqlen,
+so every batch has a fresh shape — fine for eager torch, poison for XLA,
+where every distinct shape is a recompile. Here packed batches are padded
+up to a small set of static **seqlen buckets** (powers of two by default),
+so the engine's jitted step compiles once per bucket and is reused across
+the run. LR scaling is a pure schedule wrapper (a ``step -> lr`` function,
+like everything in :mod:`runtime/lr_schedules`), so it composes with any
+base schedule and checkpoints for free (state = step count, as in the
+reference's ``state_dict``).
+"""
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Schedule = Callable[[int], float]
+
+
+# ---------------------------------------------------------------------------
+# Packing
+# ---------------------------------------------------------------------------
+
+def batch_by_seqlens(seqlens: Sequence[int],
+                     max_tokens: int,
+                     min_batch_size: int = 1,
+                     max_batch_size: Optional[int] = None,
+                     sequence_picking_order: str = "dataloader",
+                     seed: Optional[int] = None,
+                     ) -> Tuple[List[List[int]], List[int], List[int]]:
+    """Pack sample indices into microbatches of ≤ ``max_tokens`` tokens.
+
+    Returns ``(microbatch_ids, batch_sizes, batch_max_seqlens)`` where
+    ``microbatch_ids[i]`` is the list of dataset indices in microbatch i,
+    ``batch_sizes[i]`` its sequence count (drives LR scaling), and
+    ``batch_max_seqlens[i]`` its longest sequence (drives bucket choice).
+
+    ``sequence_picking_order``: 'dataloader' (given order), 'random', or
+    'seqlen' (ascending — minimizes padding, maximizes shape reuse).
+    Samples longer than ``max_tokens`` are dropped with a warning, as in
+    the reference.
+    """
+    if sequence_picking_order not in ("dataloader", "random", "seqlen"):
+        raise ValueError(f"unknown sequence_picking_order "
+                         f"'{sequence_picking_order}'")
+    pairs = [(int(l), i) for i, l in enumerate(seqlens)]
+    long_ids = [i for l, i in pairs if l > max_tokens]
+    if long_ids:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "variable_batch: dropping %d samples longer than max_tokens=%d",
+            len(long_ids), max_tokens)
+        pairs = [p for p in pairs if p[0] <= max_tokens]
+    if sequence_picking_order == "random":
+        rng = np.random.default_rng(seed)
+        rng.shuffle(pairs)
+    elif sequence_picking_order == "seqlen":
+        pairs.sort()
+
+    microbatch_ids: List[List[int]] = []
+    batch_sizes: List[int] = []
+    batch_max_seqlens: List[int] = []
+    dropped_small = 0
+    cur: List[Tuple[int, int]] = []
+    cur_tokens = 0
+    for l, i in pairs:
+        over_tokens = cur_tokens + l > max_tokens
+        over_count = max_batch_size is not None and len(cur) >= max_batch_size
+        if cur and (over_tokens or over_count):
+            if len(cur) >= min_batch_size:
+                microbatch_ids.append([i_ for _, i_ in cur])
+                batch_sizes.append(len(cur))
+                batch_max_seqlens.append(max(l_ for l_, _ in cur))
+            else:
+                dropped_small += len(cur)
+            cur, cur_tokens = [], 0
+        cur.append((l, i))
+        cur_tokens += l
+    if cur:
+        if len(cur) >= min_batch_size:
+            microbatch_ids.append([i_ for _, i_ in cur])
+            batch_sizes.append(len(cur))
+            batch_max_seqlens.append(max(l_ for l_, _ in cur))
+        else:
+            dropped_small += len(cur)
+    if dropped_small:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "variable_batch: dropped %d samples from groups smaller than "
+            "min_batch_size=%d", dropped_small, min_batch_size)
+    return microbatch_ids, batch_sizes, batch_max_seqlens
+
+
+def seqlen_bucket(max_seqlen: int, buckets: Optional[Sequence[int]] = None,
+                  multiple: int = 128) -> int:
+    """Round a batch's max seqlen up to a static compile bucket.
+
+    Default buckets are powers of two ≥ 128 (each distinct bucket is one
+    XLA compilation of the train step; log2 growth bounds the compile
+    count). Pass explicit ``buckets`` to pin them."""
+    if buckets is not None:
+        for b in sorted(buckets):
+            if max_seqlen <= b:
+                return int(b)
+        raise ValueError(f"max_seqlen {max_seqlen} exceeds largest bucket "
+                         f"{max(buckets)}")
+    return max(multiple, 1 << int(math.ceil(math.log2(max_seqlen))))
+
+
+# ---------------------------------------------------------------------------
+# LR scaling
+# ---------------------------------------------------------------------------
+
+def scale_lr(base_batch_size: int, batch_size: int, base_lr: float = 1.0,
+             method: str = "linear") -> float:
+    """Linear Scaling Rule (Goyal et al.) / sqrt rule (Krizhevsky) /
+    'none'."""
+    if method == "linear":
+        return base_lr * batch_size / base_batch_size
+    if method == "sqrt":
+        return base_lr * math.sqrt(batch_size / base_batch_size)
+    if method is None or str(method).lower() == "none":
+        return base_lr
+    raise ValueError(f"unknown lr_scaling_method '{method}'")
+
+
+def variable_batch_lr_schedule(base_schedule: Schedule,
+                               base_batch_size: int,
+                               batch_sizes: Sequence[int],
+                               method: str = "linear") -> Schedule:
+    """Wrap any ``step -> lr`` schedule so each step's LR is scaled by
+    that step's realized batch size (reference VariableBatchSizeLR.step,
+    :279). Steps past the packed plan reuse the last batch size."""
+    sizes = np.asarray(batch_sizes, np.int64)
+
+    def fn(step: int) -> float:
+        bs = int(sizes[min(int(step), len(sizes) - 1)])
+        return scale_lr(base_batch_size, bs, base_schedule(step), method)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Dataloader
+# ---------------------------------------------------------------------------
+
+class VariableBatchDataLoader:
+    """Iterate packed microbatches as padded, DP-sharded numpy dicts.
+
+    Each yielded batch is ``{"input_ids": [nb, sb] int32,
+    "attention_mask": [nb, sb] int32}`` where BOTH dims are rounded up to
+    power-of-two buckets — distinct shapes are what trigger XLA
+    recompiles, so the compile count is O(log² sizes), not O(batches).
+    Padding rows have ``attention_mask == 0`` everywhere; consumers must
+    mask the loss with it (e.g. ``labels = where(mask, ids, -100)``).
+    ``dataset[i]`` must return a 1-D int sequence. DP sharding splits the
+    microbatch's sequences across ranks (a rank left with no sequences
+    yields an all-padding batch so every rank still steps in lockstep —
+    no sample is ever duplicated into the gradient).
+    """
+
+    def __init__(self, dataset, seqlens: Sequence[int], max_tokens: int,
+                 dp_rank: int = 0, dp_world: int = 1,
+                 buckets: Optional[Sequence[int]] = None,
+                 pad_token_id: int = 0,
+                 sequence_picking_order: str = "seqlen",
+                 seed: Optional[int] = None):
+        self.dataset = dataset
+        self.pad_token_id = int(pad_token_id)
+        self.dp_rank, self.dp_world = int(dp_rank), int(dp_world)
+        self.buckets = buckets
+        (self.microbatch_ids, self.batch_sizes,
+         self.batch_max_seqlens) = batch_by_seqlens(
+             seqlens, max_tokens,
+             sequence_picking_order=sequence_picking_order, seed=seed)
+
+    def __len__(self) -> int:
+        return len(self.microbatch_ids)
+
+    def lr_schedule(self, base_schedule: Schedule, base_batch_size: int,
+                    method: str = "linear") -> Schedule:
+        return variable_batch_lr_schedule(base_schedule, base_batch_size,
+                                          self.batch_sizes, method)
+
+    def __iter__(self):
+        for ids, max_len in zip(self.microbatch_ids,
+                                self.batch_max_seqlens):
+            mine = ids[self.dp_rank::self.dp_world]
+            bucket = seqlen_bucket(max_len, self.buckets)
+            # batch bucket from the GLOBAL per-rank ceiling so every DP
+            # rank yields the SAME shape this step (SPMD lockstep)
+            per_rank = -(-len(ids) // self.dp_world)
+            nb = 1 << max(per_rank - 1, 0).bit_length()
+            input_ids = np.full((nb, bucket), self.pad_token_id, np.int32)
+            mask = np.zeros((nb, bucket), np.int32)
+            for r, idx in enumerate(mine):
+                seq = np.asarray(self.dataset[idx], np.int32)
+                input_ids[r, :len(seq)] = seq
+                mask[r, :len(seq)] = 1
+            yield {"input_ids": input_ids, "attention_mask": mask}

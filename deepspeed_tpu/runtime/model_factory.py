@@ -33,7 +33,8 @@ def register_attention_impl(name: str, fn) -> None:
     _ATTENTION_REGISTRY[name] = fn
 
 
-def select_attention(ds_cfg: DeepSpeedTPUConfig):
+def select_attention(ds_cfg: DeepSpeedTPUConfig,
+                     dec_cfg: Optional[DecoderConfig] = None):
     """Pick the attention implementation from the config (reference: the
     replace_with_kernel_inject seam + DistributedAttention wrapping,
     sequence/layer.py:331).
@@ -46,6 +47,19 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig):
     on_tpu = _jax.default_backend() == "tpu"
     sp = ds_cfg.sequence_parallel
     impl = ds_cfg.attention_impl
+    if dec_cfg is not None and dec_cfg.pos_emb == "alibi":
+        # ALiBi (BLOOM) adds a per-head score bias; the Pallas flash
+        # kernel has no bias port, and head-sharded SP would need the
+        # matching slope slice per shard — route to the chunked-XLA path
+        # (still never materializes [T,T]) with slopes baked in.
+        if sp.size > 1:
+            raise ValueError("sequence_parallel with an ALiBi model is "
+                             "not supported; use DP/TP/PP for BLOOM-class "
+                             "models")
+        from deepspeed_tpu.models.transformer import alibi_slopes
+        from deepspeed_tpu.ops.xla_attention import chunked_attention
+        return partial(chunked_attention,
+                       alibi=alibi_slopes(dec_cfg.num_heads))
     if impl in _ATTENTION_REGISTRY:
         if sp.size > 1:
             # the builtin impls get ring/Ulysses wrapping below; silently
@@ -105,7 +119,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     """
     from deepspeed_tpu.runtime.engine import ModelSpec
 
-    attn_fn = select_attention(ds_cfg)
+    attn_fn = select_attention(ds_cfg, dec_cfg)
     moe_fn = select_moe(dec_cfg, ds_cfg)
     remat = ds_cfg.activation_checkpointing.policy
 

@@ -57,6 +57,24 @@ def pipeline_partition_specs(base_specs, stages: int):
     return out
 
 
+def _pack_embed(cfg: DecoderConfig, params):
+    """Embed tree threaded through shard_map: BLOOM's
+    word_embeddings_layernorm rides along under a reserved key so the
+    stage-0 embed can apply it (and its grads come back in the same
+    tree)."""
+    em = dict(params["embed"])
+    if cfg.embed_norm:
+        em["_embed_norm"] = params["embed_norm"]
+    return em
+
+
+def _apply_embed(cfg: DecoderConfig, em, tok, positions):
+    """Stage-0 embed: delegates to the shared transformer.embed_tokens
+    (one home for Gemma scaling / learned pos / BLOOM embed norm)."""
+    return transformer.embed_tokens(cfg, em, tok, positions,
+                                    em.get("_embed_norm"))
+
+
 def _stage_forward(cfg: DecoderConfig, local_layers, x, sin, cos,
                    attn_fn, moe_fn, remat_policy: Optional[str]):
     """Run this stage's L/S layers (scan, optional per-block remat)."""
@@ -86,7 +104,7 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
     from deepspeed_tpu.parallel.mesh import get_mesh
     mesh = mesh or get_mesh()
     S = num_stages or mesh.shape["pipe"]
-    attn_fn = attn_fn or transformer.dot_product_attention
+    attn_fn = attn_fn or transformer.default_attention(cfg)
     M, b, t = tokens.shape
     d = cfg.hidden_size
 
@@ -100,10 +118,7 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
             sin = cos = jnp.zeros((b, t, 0), jnp.float32)
 
         def embed_mb(tok):
-            x = embed["tokens"][tok]
-            if cfg.pos_emb == "learned":
-                x = x + embed["pos"][positions]
-            return x
+            return _apply_embed(cfg, embed, tok, positions)
 
         perm = [(i, (i + 1) % S) for i in range(S)]
         buf = jnp.zeros((b, t, d), embed["tokens"].dtype)
@@ -147,9 +162,10 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         return loss + aux_all
 
     head = params.get("lm_head")
+    embed_in = _pack_embed(cfg, params)
     base_specs = (
         jax.tree.map(lambda _: P("pipe"), params["layers"]),
-        jax.tree.map(lambda _: P(), params["embed"]),
+        jax.tree.map(lambda _: P(), embed_in),
         jax.tree.map(lambda _: P(), params["final_norm"]),
     )
     if head is None:
@@ -159,12 +175,12 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         fn = jax.shard_map(entry, mesh=mesh,
                            in_specs=base_specs + (P(), P()),
                            out_specs=P(), axis_names={"pipe"})
-        return fn(params["layers"], params["embed"], params["final_norm"],
+        return fn(params["layers"], embed_in, params["final_norm"],
                   tokens, labels)
     fn = jax.shard_map(per_stage, mesh=mesh,
                        in_specs=base_specs + (P(), P(), P()),
                        out_specs=P(), axis_names={"pipe"})
-    return fn(params["layers"], params["embed"], params["final_norm"],
+    return fn(params["layers"], embed_in, params["final_norm"],
               head, tokens, labels)
 
 
@@ -201,7 +217,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
     from deepspeed_tpu.parallel.mesh import get_mesh
     mesh = mesh or get_mesh()
     S = num_stages or mesh.shape["pipe"]
-    attn_fn = attn_fn or transformer.dot_product_attention
+    attn_fn = attn_fn or transformer.default_attention(cfg)
     M, b, t = tokens.shape
     d = cfg.hidden_size
     K = min(M, 2 * S - 1)
@@ -218,10 +234,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
             sin = cos = jnp.zeros((b, t, 0), jnp.float32)
 
         def embed_mb(em, tok):
-            x = em["tokens"][tok]
-            if cfg.pos_emb == "learned":
-                x = x + em["pos"][positions]
-            return x
+            return _apply_embed(cfg, em, tok, positions)
 
         def stage_fn(ly, x):
             y, aux = _stage_forward(cfg, ly, x, sin, cos, attn_fn, moe_fn,
@@ -358,16 +371,17 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
     layer_specs = jax.tree.map(lambda _: P("pipe"), params["layers"])
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
     head = params.get("lm_head")
-    in_specs = (layer_specs, rep(params["embed"]),
+    embed_in = _pack_embed(cfg, params)
+    in_specs = (layer_specs, rep(embed_in),
                 rep(params["final_norm"]))
     if head is None:
         def entry(ll, em, fn_, tk, lb):
             return per_stage(ll, em, fn_, None, tk, lb)
         out = jax.shard_map(
             entry, mesh=mesh, in_specs=in_specs + (P(), P()),
-            out_specs=(P(), layer_specs, rep(params["embed"]),
+            out_specs=(P(), layer_specs, rep(embed_in),
                        rep(params["final_norm"])),
-            axis_names={"pipe"})(params["layers"], params["embed"],
+            axis_names={"pipe"})(params["layers"], embed_in,
                                  params["final_norm"], tokens, labels)
         loss, g_layers, g_embed, g_norm = out
         grads = {"layers": g_layers, "embed": g_embed,
@@ -375,13 +389,15 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
     else:
         out = jax.shard_map(
             per_stage, mesh=mesh, in_specs=in_specs + (P(), P(), P()),
-            out_specs=(P(), layer_specs, rep(params["embed"]),
+            out_specs=(P(), layer_specs, rep(embed_in),
                        rep(params["final_norm"]), P()),
-            axis_names={"pipe"})(params["layers"], params["embed"],
+            axis_names={"pipe"})(params["layers"], embed_in,
                                  params["final_norm"], head, tokens,
                                  labels)
         loss, g_layers, g_embed, g_norm, g_head = out
         grads = {"layers": g_layers, "embed": g_embed,
                  "final_norm": g_norm, "lm_head": g_head}
+    if cfg.embed_norm:
+        grads["embed_norm"] = grads["embed"].pop("_embed_norm")
     grads = {k: grads[k] for k in params}     # preserve key order
     return loss, grads

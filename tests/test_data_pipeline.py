@@ -109,3 +109,94 @@ def test_random_ltd_indices_sorted_unique():
     assert idx.shape == (3, 8)
     for row in idx:
         assert np.all(np.diff(row) > 0)       # sorted, unique
+
+
+# ---------------------------------------------------------------------------
+# Variable batch size + LR (reference variable_batch_size_and_lr.py)
+# ---------------------------------------------------------------------------
+
+from deepspeed_tpu.runtime.data_pipeline.variable_batch import (  # noqa: E402
+    VariableBatchDataLoader, batch_by_seqlens, scale_lr, seqlen_bucket,
+    variable_batch_lr_schedule)
+
+
+def test_batch_by_seqlens_token_budget():
+    lens = [10, 20, 30, 40, 50, 60, 5, 5]
+    mbs, sizes, maxlens = batch_by_seqlens(lens, max_tokens=64,
+                                           sequence_picking_order="seqlen")
+    # every microbatch respects the token budget
+    for ids, maxlen in zip(mbs, maxlens):
+        assert sum(lens[i] for i in ids) <= 64
+        assert maxlen == max(lens[i] for i in ids)
+    # every sample appears at most once; sizes match
+    flat = [i for ids in mbs for i in ids]
+    assert len(flat) == len(set(flat))
+    assert sizes == [len(ids) for ids in mbs]
+
+
+def test_batch_by_seqlens_drops_overlong():
+    mbs, _, _ = batch_by_seqlens([10, 999, 12], max_tokens=64)
+    flat = [i for ids in mbs for i in ids]
+    assert 1 not in flat and set(flat) == {0, 2}
+
+
+def test_scale_lr_rules():
+    assert scale_lr(8, 16, 1e-3, "linear") == pytest.approx(2e-3)
+    assert scale_lr(8, 32, 1e-3, "sqrt") == pytest.approx(2e-3)
+    assert scale_lr(8, 32, 1e-3, "none") == pytest.approx(1e-3)
+    with pytest.raises(ValueError):
+        scale_lr(8, 16, 1e-3, "bogus")
+
+
+def test_seqlen_bucket_static_shapes():
+    assert seqlen_bucket(100) == 128
+    assert seqlen_bucket(129) == 256
+    assert seqlen_bucket(300, buckets=[128, 512, 2048]) == 512
+    with pytest.raises(ValueError):
+        seqlen_bucket(4096, buckets=[128, 512])
+
+
+def test_variable_batch_lr_schedule_scales_per_step():
+    sched = variable_batch_lr_schedule(lambda s: 1e-2, base_batch_size=4,
+                                       batch_sizes=[4, 8, 2], method="linear")
+    assert sched(0) == pytest.approx(1e-2)
+    assert sched(1) == pytest.approx(2e-2)
+    assert sched(2) == pytest.approx(0.5e-2)
+    assert sched(99) == pytest.approx(0.5e-2)   # clamps to last
+
+
+def test_variable_batch_dataloader_padded_buckets():
+    docs = _docs(30, seed=1)
+    lens = [len(d) for d in docs]
+    dl = VariableBatchDataLoader(docs, lens, max_tokens=128,
+                                 dp_rank=0, dp_world=2, pad_token_id=0)
+    seen = 0
+    for batch, ids, maxlen in zip(dl, dl.microbatch_ids,
+                                  dl.batch_max_seqlens):
+        bucket = seqlen_bucket(maxlen)
+        assert batch["input_ids"].shape[1] == bucket
+        assert batch["input_ids"].shape == batch["attention_mask"].shape
+        mine = ids[0::2]
+        nb = batch["input_ids"].shape[0]
+        # batch dim bucketed to a power of two, padding rows fully masked
+        assert nb >= max(len(mine), 1) and (nb & (nb - 1)) == 0
+        for r, idx in enumerate(mine):
+            n = len(docs[idx])
+            np.testing.assert_array_equal(batch["input_ids"][r, :n],
+                                          docs[idx])
+            assert batch["attention_mask"][r, :n].all()
+            assert not batch["attention_mask"][r, n:].any()
+        assert not batch["attention_mask"][len(mine):].any()
+        seen += 1
+    assert seen == len(dl) and seen > 0
+
+
+def test_variable_batch_empty_rank_no_duplication():
+    docs = [[1, 2, 3], [4, 5, 6]]
+    # dp_world=4: ranks 2,3 get nothing — must yield all-padding, never a
+    # duplicated sample (which would double-count its gradient)
+    dl = VariableBatchDataLoader(docs, [3, 3], max_tokens=8, dp_rank=3,
+                                 dp_world=4)
+    batches = list(dl)
+    assert len(batches) == 1
+    assert not batches[0]["attention_mask"].any()
