@@ -71,15 +71,14 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
     positions = starts[:, None] + jnp.broadcast_to(
         jnp.arange(c, dtype=jnp.int32)[None], (n, c))
     if cfg.pos_emb == "learned":
-        maxpos = params["embed"]["pos"].shape[0]
-        emb_pos = jnp.minimum(positions, maxpos - 1)
-        sin = cos = jnp.zeros((n, c, 0), jnp.float32)
+        emb_pos = jnp.minimum(positions, params["embed"]["pos"].shape[0] - 1)
     else:
         emb_pos = positions
-        sin, cos = rope_table(cfg, positions)
     x = embed_tokens(cfg, params["embed"], tokens, emb_pos,
                      params.get("embed_norm"))
-    if cfg.pos_emb != "rope":
+    if cfg.pos_emb == "rope":
+        sin, cos = rope_table(cfg, positions)
+    else:
         sin = cos = jnp.zeros((n, c, 0), x.dtype)
 
     attend = pa.paged_attention if use_pallas else pa.paged_attention_xla
@@ -130,6 +129,15 @@ class RaggedInferenceEngineTPU:
                  params=None, rng: Optional[jax.Array] = None):
         if isinstance(config, dict) or config is None:
             config = RaggedInferenceConfig(**(config or {}))
+        if model.sliding_window is not None and \
+                config.max_seq_len > model.sliding_window:
+            # the paged kernels attend the full page table; beyond the
+            # window that silently diverges from the training forward
+            raise NotImplementedError(
+                f"ragged/paged inference has no sliding-window mask: "
+                f"max_seq_len {config.max_seq_len} exceeds sliding_window "
+                f"{model.sliding_window}; cap max_seq_len at the window "
+                f"or use InferenceEngineTPU")
         self.model_config = model
         self.config = config
         self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,

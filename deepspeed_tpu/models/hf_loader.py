@@ -73,15 +73,20 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
         use_bias=(mt == "qwen2"),   # qwen2: qkv bias only; handled in map
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        kw["sliding_window"] = int(hf["sliding_window"])
     if mt == "mixtral":
         kw.update(num_experts=hf["num_local_experts"],
                   num_experts_per_tok=hf.get("num_experts_per_tok", 2))
     if mt == "gemma":
         # gemma stores RMSNorm as (1 + w) — folded into `scale` at load —
         # plus GeGLU, sqrt(d)-scaled embeddings and a decoupled head_dim
+        # (GemmaConfig's DEFAULT is 256, NOT hidden//heads)
         kw.update(activation="gelu_glu", scale_embeddings=True,
-                  head_dim_override=hf.get("head_dim"),
+                  head_dim_override=int(hf.get("head_dim", 256)),
                   tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+        if hf.get("final_logit_softcapping"):
+            kw["logit_softcap"] = float(hf["final_logit_softcapping"])
     return DecoderConfig(**kw)
 
 
@@ -145,12 +150,16 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "torch_dtype": "float32",
     }
+    if cfg.sliding_window is not None:
+        hf["sliding_window"] = cfg.sliding_window
     if _is_gemma_layout(cfg):
         # always explicit: GemmaConfig's DEFAULT head_dim is 256, not
         # hidden//heads — an omitted key reloads with the wrong shape
         hf["head_dim"] = cfg.head_dim
         hf["hidden_act"] = "gelu_pytorch_tanh"
         hf["hidden_activation"] = "gelu_pytorch_tanh"
+        if cfg.logit_softcap:
+            hf["final_logit_softcapping"] = cfg.logit_softcap
     elif cfg.head_dim_override is not None:
         hf["head_dim"] = cfg.head_dim_override
     if cfg.num_experts:

@@ -1,6 +1,6 @@
 """Mistral family presets (reference: inference/v2/model_implementations/
-mistral/ — same decoder family as Llama with sliding-window-free GQA
-config; HF-loadable via models/hf_loader.py)."""
+mistral/ — Llama-family decoder with GQA and sliding-window attention
+(v0.1: window 4096); HF-loadable via models/hf_loader.py)."""
 
 from deepspeed_tpu.models.transformer import DecoderConfig
 
@@ -11,7 +11,8 @@ def mistral_config(size: str = "7b", **overrides) -> DecoderConfig:
                      num_kv_heads=2, intermediate_size=128, vocab_size=512,
                      max_seq_len=256),
         "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
-                   num_kv_heads=8, intermediate_size=14336),
+                   num_kv_heads=8, intermediate_size=14336,
+                   sliding_window=4096),
     }
     base = dict(vocab_size=32000, max_seq_len=8192, norm="rmsnorm",
                 activation="silu_glu", pos_emb="rope", rope_theta=10000.0,

@@ -78,6 +78,9 @@ class DecoderConfig:
     scale_embeddings: bool = False
     #: BLOOM word_embeddings_layernorm: a norm between embed and block 0
     embed_norm: bool = False
+    #: causal sliding-window attention (Mistral SWA): each query sees at
+    #: most the last `sliding_window` keys; None = full causal
+    sliding_window: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:
@@ -240,13 +243,15 @@ def alibi_slopes(num_heads: int) -> jax.Array:
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
                           q_offset: int = 0,
-                          alibi: Optional[jax.Array] = None) -> jax.Array:
+                          alibi: Optional[jax.Array] = None,
+                          window: Optional[int] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     GQA handled by head repetition at the einsum level (no materialized
     repeat). fp32 softmax for numerics; XLA fuses the whole block onto MXU.
     ``alibi``: per-head slopes [H] → adds slope·(kpos − qpos) to the
-    scores (BLOOM/Press-et-al. linear position bias).
+    scores (BLOOM/Press-et-al. linear position bias). ``window``: causal
+    sliding window (Mistral SWA) — key kp visible iff qp−window < kp ≤ qp.
     """
     b, tq, h, dh = q.shape
     _, tk, kvh, _ = k.shape
@@ -261,8 +266,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         rel = (kpos[None, :] - qpos[:, None]).astype(jnp.float32)  # ≤ 0 kept
         scores = scores + alibi.reshape(kvh, groups)[None, :, :, None, None] \
             * rel[None, None, None]
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]
+    if causal or window is not None:
+        mask = qpos[:, None] >= kpos[None, :] if causal else \
+            jnp.ones((tq, tk), bool)
+        if window is not None:
+            mask = mask & (kpos[None, :] > qpos[:, None] - window)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
@@ -279,6 +287,8 @@ def default_attention(cfg: DecoderConfig) -> AttentionFn:
     if cfg.pos_emb == "alibi":
         return partial(dot_product_attention,
                        alibi=alibi_slopes(cfg.num_heads))
+    if cfg.sliding_window is not None:
+        return partial(dot_product_attention, window=cfg.sliding_window)
     return dot_product_attention
 
 
@@ -661,6 +671,8 @@ def _cached_attention(cfg: DecoderConfig, p: Params, x, sin, cos,
         scores = scores + alibi_slopes(cfg.num_heads).reshape(
             kvh, groups)[None, :, :, None, None] * rel[None, None, None]
     mask = qpos[:, None] >= kpos[None, :]
+    if cfg.sliding_window is not None:
+        mask = mask & (kpos[None, :] > qpos[:, None] - cfg.sliding_window)
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v_cache)

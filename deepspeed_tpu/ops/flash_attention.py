@@ -42,7 +42,8 @@ DEFAULT_BLOCK_K = 512
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale: float, causal: bool, block_k: int, q_offset: int):
+                scale: float, causal: bool, block_k: int, q_offset: int,
+                window: Optional[int]):
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
@@ -63,6 +64,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             lax.div(q_start + block_q + block_k - 1, jnp.int32(block_k)))
     else:
         num_kb_dyn = jnp.int32(num_kb)
+    if window is not None:
+        # sliding window (Mistral SWA): key kp visible to query qp iff
+        # qp - window < kp <= qp — blocks left of the window are SKIPPED,
+        # so FLOPs scale with window, not T²
+        kb_start = lax.max(
+            jnp.int32(0),
+            lax.div(q_start - jnp.int32(window) + 1, jnp.int32(block_k)))
+    else:
+        kb_start = jnp.int32(0)
 
     def body(kb, carry):
         acc, m, l = carry
@@ -70,10 +80,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             kpos = kb * block_k + \
                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            ok = (qpos >= kpos) if causal else \
+                jnp.full_like(qpos, True, dtype=jnp.bool_)
+            if window is not None:
+                ok = jnp.logical_and(ok, kpos > qpos - window)
+            s = jnp.where(ok, s, _NEG_INF)
         blk_max = jnp.max(s, axis=1)                        # [BQ]
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m[:, None])
@@ -90,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = lax.fori_loop(0, num_kb_dyn, body, (acc0, m0, l0))
+    acc, m, l = lax.fori_loop(kb_start, num_kb_dyn, body, (acc0, m0, l0))
 
     safe_l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
@@ -100,7 +114,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m > _NEG_INF / 2, m + jnp.log(safe_l), _NEG_INF)
 
 
-def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, interpret):
+def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, window,
+         interpret):
     bh, tq, d = q.shape
     bkv, tk, _ = k.shape
     g = bh // bkv
@@ -108,7 +123,8 @@ def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, interpret):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, q_offset=q_offset),
+                          block_k=block_k, q_offset=q_offset,
+                          window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -134,7 +150,7 @@ def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale: float, causal: bool, block_k: int,
-                   q_offset: int):
+                   q_offset: int, window: Optional[int]):
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
@@ -154,16 +170,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             lax.div(q_start + block_q + block_k - 1, jnp.int32(block_k)))
     else:
         num_kb_dyn = jnp.int32(num_kb)
+    if window is not None:
+        kb_start = lax.max(
+            jnp.int32(0),
+            lax.div(q_start - jnp.int32(window) + 1, jnp.int32(block_k)))
+    else:
+        kb_start = jnp.int32(0)
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             kpos = kb * block_k + \
                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            ok = (qpos >= kpos) if causal else \
+                jnp.full_like(qpos, True, dtype=jnp.bool_)
+            if window is not None:
+                ok = jnp.logical_and(ok, kpos > qpos - window)
+            s = jnp.where(ok, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -172,14 +198,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                   preferred_element_type=jnp.float32)
         return dq
 
-    dq = lax.fori_loop(0, num_kb_dyn, body,
+    dq = lax.fori_loop(kb_start, num_kb_dyn, body,
                        jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, q_offset: int):
+                    block_q: int, q_offset: int, window: Optional[int]):
     ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     seq_q = q_ref.shape[1]
@@ -199,6 +225,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     jnp.int32(block_q)))
     else:
         first_qb = jnp.int32(0)
+    if window is not None:
+        # queries beyond k_end-1 + window - 1 can't see this k block
+        num_qb_dyn = lax.min(
+            jnp.int32(num_qb),
+            lax.div(k_start + block_k - 1 + jnp.int32(window) - 1
+                    - q_offset, jnp.int32(block_q)) + 1)
+    else:
+        num_qb_dyn = jnp.int32(num_qb)
 
     def body(qb, carry):
         dk, dv = carry
@@ -208,10 +242,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             qpos = qb * block_q + q_offset + \
                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            ok = (qpos >= kpos) if causal else \
+                jnp.full_like(qpos, True, dtype=jnp.bool_)
+            if window is not None:
+                ok = jnp.logical_and(ok, kpos > qpos - window)
+            s = jnp.where(ok, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv = dv + lax.dot_general(p.astype(do.dtype), do,
                                   (((0,), (0,)), ((), ())),
@@ -225,13 +263,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
+    dk, dv = lax.fori_loop(first_qb, num_qb_dyn, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
-         interpret):
+         window, interpret):
     bh, tq, d = q.shape
     bkv, tk, _ = k.shape
     g = bh // bkv
@@ -240,7 +278,8 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, q_offset=q_offset),
+                          block_k=block_k, q_offset=q_offset,
+                          window=window),
         grid=(bh, tq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -258,7 +297,8 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
     # dk/dv per q-head, summed over the GQA group afterwards
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, q_offset=q_offset),
+                          block_q=block_q, q_offset=q_offset,
+                          window=window),
         grid=(bh, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
@@ -291,24 +331,26 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, q_offset, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, q_offset, block_q, block_k, window, interpret):
     out, _ = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
-                  block_q, block_k, interpret)
+                  block_q, block_k, window, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, window,
+               interpret):
     out, lse = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
-                    block_q, block_k, interpret)
+                    block_q, block_k, window, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, q_offset, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, q_offset, block_q, block_k, window, interpret, res,
+               g):
     q, k, v, out, lse = res
     dq, dk, dv = _bwd(q, k, v, out, lse, g,
                       1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
-                      block_q, block_k, interpret)
+                      block_q, block_k, window, interpret)
     return dq, dk, dv
 
 
@@ -334,12 +376,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     q_offset: int = 0,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    window: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Drop-in ``attn_fn``: q [B,T,H,D], k/v [B,T,KvH,D] → [B,T,H,D].
 
     Uses the Pallas kernel on TPU (or interpret mode elsewhere when forced
     via ``interpret=True``); falls back to the XLA reference path for
-    unsupported shapes.
+    unsupported shapes. ``window``: causal sliding window (Mistral SWA) —
+    out-of-window key BLOCKS are skipped, so long-seq FLOPs scale with
+    T·window instead of T².
     """
     b, tq, h, d = q.shape
     _, tk, kvh, _ = k.shape
@@ -371,12 +416,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"kvh={kvh}) outside kernel support; using the XLA reference "
             f"path (slower — check block/tile divisibility)")
         return dot_product_attention(q, k, v, causal=causal,
-                                     q_offset=q_offset)
+                                     q_offset=q_offset, window=window)
 
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
-    out = _flash(qf, kf, vf, causal, q_offset, bq, bk, interpret)
+    out = _flash(qf, kf, vf, causal, q_offset, bq, bk, window, interpret)
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
 
 

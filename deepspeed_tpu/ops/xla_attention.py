@@ -26,25 +26,31 @@ _NEG_INF = -1e30
 
 
 def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
-                q_start: int, causal: bool, scale: float,
-                alibi: Optional[jax.Array] = None) -> jax.Array:
-    """One query chunk vs a key prefix.
+                q_start: int, k_start: int = 0, *,
+                causal: bool, scale: float,
+                alibi: Optional[jax.Array] = None,
+                window: Optional[int] = None) -> jax.Array:
+    """One query chunk vs a key slice starting at position ``k_start``.
 
     qg: [B, Cq, KV, G, Dh], k/v: [B, Tk, KV, Dh] → [B, Cq, KV, G, Dh].
     ``alibi``: per-head slopes [H] (BLOOM linear position bias).
+    ``window``: causal sliding window (keys ≤ window behind the query).
     """
     b, cq, kvh, g, dh = qg.shape
     tk = k.shape[1]
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
                         preferred_element_type=jnp.float32) * scale
     qpos = q_start + jnp.arange(cq)
-    kpos = jnp.arange(tk)
+    kpos = k_start + jnp.arange(tk)
     if alibi is not None:
         rel = (kpos[None, :] - qpos[:, None]).astype(jnp.float32)
         scores = scores + alibi.reshape(kvh, g)[None, :, :, None, None] \
             * rel[None, None, None]
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]
+    if causal or window is not None:
+        mask = qpos[:, None] >= kpos[None, :] if causal else \
+            jnp.ones((cq, tk), bool)
+        if window is not None:
+            mask = mask & (kpos[None, :] > qpos[:, None] - window)
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bkgts,bskd->btkgd", probs, v)
@@ -54,7 +60,8 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = True,
                       q_offset: int = 0,
                       chunk_q: int = 256,
-                      alibi: Optional[jax.Array] = None) -> jax.Array:
+                      alibi: Optional[jax.Array] = None,
+                      window: Optional[int] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     The q-chunk loop is unrolled at trace time so each chunk attends to a
@@ -66,14 +73,16 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, tq, h, dh = q.shape
     _, tk, kvh, _ = k.shape
     if tq <= chunk_q:
-        return dot_product_attention_ref(q, k, v, causal, q_offset, alibi)
+        return dot_product_attention_ref(q, k, v, causal, q_offset, alibi,
+                                         window)
     g = h // kvh
     scale = 1.0 / math.sqrt(dh)
     qg = q.reshape(b, tq, kvh, g, dh)
 
     chunk_fn = jax.checkpoint(
-        partial(_chunk_attn, causal=causal, scale=scale, alibi=alibi),
-        static_argnums=(3,))
+        partial(_chunk_attn, causal=causal, scale=scale, alibi=alibi,
+                window=window),
+        static_argnums=(3, 4))
 
     # full chunks plus a static remainder chunk for non-multiple lengths
     bounds = list(range(0, tq, chunk_q)) + [tq]
@@ -81,21 +90,28 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         q_start = lo + q_offset
         qc = jax.lax.slice_in_dim(qg, lo, hi, axis=1)
-        if causal:
-            # static causal prefix: keys up to this chunk's last row
-            k_end = min(tk, q_start + (hi - lo))
-            kc = jax.lax.slice_in_dim(k, 0, k_end, axis=1)
-            vc = jax.lax.slice_in_dim(v, 0, k_end, axis=1)
+        k_lo = 0
+        if causal or window is not None:
+            # static key slice: causal prefix, minus keys left of the
+            # sliding window (both bounds trace-time — the skipped FLOPs
+            # are genuinely gone, not masked)
+            k_end = min(tk, q_start + (hi - lo)) if causal else tk
+            if window is not None:
+                k_lo = max(0, q_start - window + 1)
+            kc = jax.lax.slice_in_dim(k, k_lo, k_end, axis=1)
+            vc = jax.lax.slice_in_dim(v, k_lo, k_end, axis=1)
         else:
             kc, vc = k, v
-        outs.append(chunk_fn(qc, kc, vc, q_start))
+        outs.append(chunk_fn(qc, kc, vc, q_start, k_lo))
     return jnp.concatenate(outs, axis=1).reshape(b, tq, h, dh)
 
 
-def dot_product_attention_ref(q, k, v, causal=True, q_offset=0, alibi=None):
+def dot_product_attention_ref(q, k, v, causal=True, q_offset=0, alibi=None,
+                              window=None):
     """Single-chunk fallback (same math, full prefix)."""
     b, tq, h, dh = q.shape
     kvh = k.shape[2]
     qg = q.reshape(b, tq, kvh, h // kvh, dh)
-    out = _chunk_attn(qg, k, v, q_offset, causal, 1.0 / math.sqrt(dh), alibi)
+    out = _chunk_attn(qg, k, v, q_offset, causal=causal,
+                      scale=1.0 / math.sqrt(dh), alibi=alibi, window=window)
     return out.reshape(b, tq, h, dh)

@@ -47,6 +47,26 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
     on_tpu = _jax.default_backend() == "tpu"
     sp = ds_cfg.sequence_parallel
     impl = ds_cfg.attention_impl
+    if impl in _ATTENTION_REGISTRY:
+        if sp.size > 1:
+            # the builtin impls get ring/Ulysses wrapping below; silently
+            # running a raw custom impl on sequence shards would compute
+            # wrong attention — make the combination an explicit error
+            raise ValueError(
+                f"attention_impl '{impl}' (registered) does not compose "
+                f"with sequence_parallel.size={sp.size}: custom impls "
+                f"must handle the 'seq' axis themselves — register an "
+                f"SP-aware fn or use a builtin impl")
+        if dec_cfg is not None and (dec_cfg.pos_emb == "alibi"
+                                    or dec_cfg.sliding_window is not None):
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once(
+                f"attention_impl '{impl}' (registered) is used as-is for "
+                f"a model with "
+                f"{'ALiBi' if dec_cfg.pos_emb == 'alibi' else 'sliding-window'}"
+                f" attention — the impl itself must apply the "
+                f"bias/window or results will silently differ")
+        return _ATTENTION_REGISTRY[impl]
     if dec_cfg is not None and dec_cfg.pos_emb == "alibi":
         # ALiBi (BLOOM) adds a per-head score bias; the Pallas flash
         # kernel has no bias port, and head-sharded SP would need the
@@ -60,17 +80,12 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
         from deepspeed_tpu.ops.xla_attention import chunked_attention
         return partial(chunked_attention,
                        alibi=alibi_slopes(dec_cfg.num_heads))
-    if impl in _ATTENTION_REGISTRY:
-        if sp.size > 1:
-            # the builtin impls get ring/Ulysses wrapping below; silently
-            # running a raw custom impl on sequence shards would compute
-            # wrong attention — make the combination an explicit error
-            raise ValueError(
-                f"attention_impl '{impl}' (registered) does not compose "
-                f"with sequence_parallel.size={sp.size}: custom impls "
-                f"must handle the 'seq' axis themselves — register an "
-                f"SP-aware fn or use a builtin impl")
-        return _ATTENTION_REGISTRY[impl]
+    window = dec_cfg.sliding_window if dec_cfg is not None else None
+    if window is not None and sp.size > 1:
+        raise ValueError(
+            "sequence_parallel with sliding-window attention is not "
+            "supported yet (the ring/Ulysses wrappers assume full causal "
+            "attention); unset sliding_window or sequence_parallel")
     if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
         raise ValueError(
             f"unknown attention_impl '{impl}'; expected 'auto'|"
@@ -79,6 +94,7 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
     if sp.size > 1 and sp.mode == "ring":
         from deepspeed_tpu.parallel.ring import ring_attention
         return partial(ring_attention, axis_name="seq")
+    wkw = {} if window is None else {"window": window}
     if impl == "pallas_flash" or (impl == "auto" and on_tpu and
                                   not os.environ.get("DSTPU_NO_PALLAS_ATTN")):
         # mesh-aware Pallas flash kernel — the TPU default: measured
@@ -86,15 +102,19 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
         # path on the 1.27B seq-2048 bench (v5e); shard_map head-sharding over
         # ('model','seq') IS the Ulysses all-to-all when sp > 1.
         # Unsupported shapes fall back inside flash_attention_sharded.
+        # Sliding-window models pass `window` through: the kernel skips
+        # out-of-window key blocks entirely (T·window FLOPs, not T²).
         from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
-        return flash_attention_sharded
+        return partial(flash_attention_sharded, **wkw) if wkw \
+            else flash_attention_sharded
     if sp.size > 1:
         from deepspeed_tpu.parallel.ulysses import distributed_attention
         return partial(distributed_attention, axis_name="seq")
     if impl == "naive" or (impl == "auto" and not on_tpu):
-        return dot_product_attention
+        return partial(dot_product_attention, **wkw) if wkw \
+            else dot_product_attention
     from deepspeed_tpu.ops.xla_attention import chunked_attention
-    return chunked_attention
+    return partial(chunked_attention, **wkw) if wkw else chunked_attention
 
 
 def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):

@@ -1,0 +1,201 @@
+"""Sliding-window + block-sparse attention tests (reference:
+ops/sparse_attention triton kernels; Mistral SWA config)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import dot_product_attention
+from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.sparse_attention import (bigbird_pattern,
+                                                block_sparse_attention,
+                                                fixed_pattern,
+                                                local_pattern, sparsity)
+from deepspeed_tpu.ops.xla_attention import chunked_attention
+
+
+def _qkv(b=2, t=256, h=4, kvh=2, dh=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, t, h, dh)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, t, kvh, dh)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, t, kvh, dh)), dtype)
+    return q, k, v
+
+
+def _window_reference(q, k, v, window):
+    """Dense attention with an explicit window mask — ground truth."""
+    return dot_product_attention(q, k, v, causal=True, window=window)
+
+
+def test_window_restricts_receptive_field():
+    """With window=W, perturbing a key more than W behind a query must
+    not change that query's output."""
+    q, k, v = _qkv(t=64)
+    w = 16
+    out = np.asarray(dot_product_attention(q, k, v, window=w))
+    k2 = k.at[:, 10].set(jnp.zeros_like(k[:, 10]))   # key at pos 10
+    v2 = v.at[:, 10].set(jnp.zeros_like(v[:, 10]))
+    out2 = np.asarray(dot_product_attention(q, k2, v2, window=w))
+    # queries ≥ 10 + w unaffected; query 10..10+w-1 affected
+    np.testing.assert_array_equal(out[:, 10 + w:], out2[:, 10 + w:])
+    assert np.abs(out[:, 10:10 + w] - out2[:, 10:10 + w]).max() > 0
+
+
+def test_window_equals_full_when_large():
+    q, k, v = _qkv(t=64)
+    a = np.asarray(dot_product_attention(q, k, v))
+    b = np.asarray(dot_product_attention(q, k, v, window=64))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_chunked_window_matches_naive():
+    q, k, v = _qkv(t=512)
+    a = np.asarray(dot_product_attention(q, k, v, window=100))
+    b = np.asarray(chunked_attention(q, k, v, chunk_q=128, window=100))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [128, 200, 512])
+def test_flash_window_matches_naive(window):
+    """Pallas kernel (interpret mode on CPU) with sliding window — both
+    values and gradients must match the dense reference."""
+    q, k, v = _qkv(t=512, dh=128, dtype=jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, window=window,
+                                       block_q=128, block_k=128,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_window_reference(q, k, v, window) ** 2)
+
+    out_f = flash_attention(q, k, v, window=window, block_q=128,
+                            block_k=128, interpret=True)
+    out_r = _window_reference(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_swa_train_and_decode_parity(devices):
+    """A sliding-window model must train through the engine and its
+    cached decode must match the training forward."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mistral import mistral_config
+    from deepspeed_tpu.models.transformer import (forward,
+                                                  forward_with_cache,
+                                                  init_kv_cache,
+                                                  init_params)
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    build_mesh(data=2, devices=jax.devices()[:2])
+    cfg = mistral_config("tiny", sliding_window=8, max_seq_len=32)
+    engine, _, _, _ = ds.initialize(
+        model=cfg,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 0}},
+        rng=jax.random.PRNGKey(0))
+    batch = {"input_ids": np.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(4, 32)), np.int32)}
+    losses = [float(engine.train_batch(iter([batch]))) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    p = init_params(cfg, jax.random.PRNGKey(1))
+    tok = jnp.asarray(np.random.default_rng(2).integers(
+        0, cfg.vocab_size, (2, 24), dtype=np.int32))
+    full = forward(cfg, p, tok)   # default_attention applies the window
+    cache = init_kv_cache(cfg, 2, 24, jnp.float32)
+    lg, cache = forward_with_cache(cfg, p, tok[:, :16], cache, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(full[:, 15]),
+                               rtol=2e-3, atol=2e-3)
+    for i in range(16, 24):
+        lg, cache = forward_with_cache(cfg, p, tok[:, i:i + 1], cache,
+                                       jnp.int32(i))
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(full[:, i]),
+                                   rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# block-sparse
+# ---------------------------------------------------------------------------
+
+def test_block_sparse_full_mask_matches_dense():
+    q, k, v = _qkv(t=256)
+    mask = np.ones((2, 2), bool)
+    a = np.asarray(block_sparse_attention(q, k, v, mask, block=128))
+    b = np.asarray(dot_product_attention(q, k, v))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+def test_block_sparse_excluded_blocks_have_no_influence():
+    q, k, v = _qkv(t=512)
+    mask = local_pattern(512, 128, num_local=2)    # see self + 1 back
+    out = np.asarray(block_sparse_attention(q, k, v, mask, block=128))
+    # zero out keys in block 0; queries in block 3 (positions 384+) see
+    # only blocks 2,3 — unchanged
+    k2 = k.at[:, :128].set(0.0)
+    v2 = v.at[:, :128].set(0.0)
+    out2 = np.asarray(block_sparse_attention(q, k2, v2, mask, block=128))
+    np.testing.assert_array_equal(out[:, 384:], out2[:, 384:])
+    assert np.abs(out[:, :128] - out2[:, :128]).max() > 0
+
+
+def test_block_sparse_matches_masked_dense():
+    """Gathered-block softmax == dense softmax with -inf on excluded
+    blocks (the gather changes layout, not math)."""
+    t, blk = 256, 64
+    q, k, v = _qkv(t=t)
+    mask = fixed_pattern(t, blk, num_local=2, stride=2)
+    sparse = np.asarray(block_sparse_attention(q, k, v, mask, block=blk))
+
+    # dense reference with elementwise mask
+    b, _, h, dh = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    qg = q.reshape(b, t, kvh, g, dh)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k) / np.sqrt(dh)
+    elem = np.kron(mask, np.ones((blk, blk), bool))
+    elem &= np.tril(np.ones((t, t), bool))
+    s = jnp.where(jnp.asarray(elem)[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    dense = jnp.einsum("bkgts,bskd->btkgd", p, v).reshape(b, t, h, dh)
+    np.testing.assert_allclose(sparse, np.asarray(dense), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_patterns_shapes_and_sparsity():
+    m = fixed_pattern(1024, 128, num_local=2, stride=4)
+    assert m.shape == (8, 8)
+    assert 0 < sparsity(m) < 1
+    bb = bigbird_pattern(1024, 128, num_local=2, num_global=1, num_random=1)
+    assert bb[:, 0].all()          # global column
+    assert np.diag(bb).all()       # diagonal always present
+    with pytest.raises(ValueError, match="no key block"):
+        block_sparse_attention(*_qkv(t=256), np.zeros((2, 2), bool),
+                               block=128)
+
+
+def test_ragged_engine_swa_gate(devices):
+    """Paged serving beyond the window must fail loudly, not silently
+    attend full-causal; capped max_seq_len is allowed."""
+    from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.mistral import mistral_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = mistral_config("tiny", sliding_window=32, max_seq_len=64)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        RaggedInferenceEngineTPU(cfg, {"max_seq_len": 64, "num_blocks": 8,
+                                       "block_size": 16})
+    eng = RaggedInferenceEngineTPU(cfg, {"dtype": "float32",
+                                         "max_seq_len": 32,
+                                         "num_blocks": 8,
+                                         "block_size": 16,
+                                         "max_sequences": 4,
+                                         "max_batch_tokens": 32})
+    outs = eng.generate([[1, 2, 3]], max_new_tokens=2)
+    assert len(outs[0]) == 5
