@@ -28,3 +28,6 @@ class QuantizationConfig:
     group_size: int = 256
     #: quantize only the frozen base (LoRA adapters stay high precision)
     mantissa_bits: int = 3   # parity field (fp6 path in the reference)
+    #: 'int8' (symmetric block quant) or 'fp8' (block-scaled e4m3 — the
+    #: reference fp_quantizer / FP6-LLM path, native dtype on TPU)
+    q_dtype: str = "int8"

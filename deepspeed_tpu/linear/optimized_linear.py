@@ -28,7 +28,10 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
-from deepspeed_tpu.ops.quantizer import dequantize_blocks, quantize_blocks
+from deepspeed_tpu.ops.quantizer import (dequantize_blocks,
+                                          dequantize_fp8_blocks,
+                                          quantize_blocks,
+                                          quantize_fp8_blocks)
 
 Params = Dict[str, Any]
 
@@ -52,15 +55,21 @@ def init_optimized_linear(rng: jax.Array, in_features: int,
             raise ValueError("OptimizedLinear quantized base supports int8 "
                              "(reference default); use ops/quantizer "
                              "directly for int4")
+        if quant.q_dtype not in ("int8", "fp8"):
+            raise ValueError(f"unknown q_dtype '{quant.q_dtype}'")
         total = out_features * in_features
         if total % quant.group_size:
             raise ValueError(
                 f"out*in ({total}) must be divisible by group_size "
                 f"({quant.group_size})")
-        q, s, _ = quantize_blocks(base.reshape(-1), block=quant.group_size,
-                                  bits=8)
-        # natural [out, in] int8 so shape metadata lives in the array;
-        # group size is recoverable as q.size // scales.size
+        if quant.q_dtype == "fp8":
+            q, s = quantize_fp8_blocks(base.reshape(-1),
+                                       block=quant.group_size)
+        else:
+            q, s, _ = quantize_blocks(base.reshape(-1),
+                                      block=quant.group_size, bits=8)
+        # natural [out, in] so shape metadata lives in the array; group
+        # size is recoverable as q.size // scales.size
         p["base_q"] = q.reshape(out_features, in_features)
         p["base_scales"] = s
     else:
@@ -80,8 +89,12 @@ def _materialize_base(p: Params, quant: Optional[QuantizationConfig],
         return p["base"].astype(dtype)
     q = p["base_q"]
     group = q.size // p["base_scales"].size
-    flat = dequantize_blocks(q.reshape(-1), p["base_scales"], block=group,
-                             bits=8, dtype=dtype)
+    if q.dtype == jnp.float8_e4m3fn:
+        flat = dequantize_fp8_blocks(q.reshape(-1), p["base_scales"],
+                                     block=group, dtype=dtype)
+    else:
+        flat = dequantize_blocks(q.reshape(-1), p["base_scales"],
+                                 block=group, bits=8, dtype=dtype)
     return flat.reshape(q.shape)
 
 

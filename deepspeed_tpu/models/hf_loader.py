@@ -73,7 +73,12 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
         use_bias=(mt == "qwen2"),   # qwen2: qkv bias only; handled in map
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
-    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+    # HF semantics differ per family: Mistral applies sliding_window
+    # whenever set; Qwen2 gates it behind use_sliding_window=False BY
+    # DEFAULT
+    use_swa_default = mt != "qwen2"
+    if hf.get("sliding_window") and hf.get("use_sliding_window",
+                                           use_swa_default):
         kw["sliding_window"] = int(hf["sliding_window"])
     if mt == "mixtral":
         kw.update(num_experts=hf["num_local_experts"],
@@ -133,6 +138,10 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         arch = ["GemmaForCausalLM"]
     elif cfg.num_experts:
         mt, arch = "mixtral", ["MixtralForCausalLM"]
+    elif cfg.sliding_window is not None:
+        # LlamaConfig has no sliding-window support — exporting SWA as
+        # 'llama' would silently reload full-causal in transformers
+        mt, arch = "mistral", ["MistralForCausalLM"]
     else:
         mt, arch = "llama", ["LlamaForCausalLM"]
     hf = {

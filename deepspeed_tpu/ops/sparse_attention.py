@@ -98,8 +98,10 @@ def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qg = q.reshape(b, tq, kvh, g, dh)
 
     @partial(jax.checkpoint, static_argnums=(3, 4))
-    def row(qc, kc, vc, q_start, kpos_tuple):
-        kpos = jnp.asarray(kpos_tuple, jnp.int32)
+    def row(qc, kc, vc, q_start, sel):
+        kpos = jnp.concatenate(
+            [jnp.arange(ki * block, (ki + 1) * block, dtype=jnp.int32)
+             for ki in sel])
         s = jnp.einsum("btkgd,bskd->bkgts", qc, kc,
                        preferred_element_type=jnp.float32) * scale
         if causal:
@@ -125,9 +127,7 @@ def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         vc = jnp.concatenate(
             [jax.lax.slice_in_dim(v, ki * block, (ki + 1) * block, axis=1)
              for ki in sel], axis=1)
-        kpos = tuple(int(x) for ki in sel
-                     for x in range(ki * block, (ki + 1) * block))
-        outs.append(row(qc, kc, vc, qi * block + q_offset, kpos))
+        outs.append(row(qc, kc, vc, qi * block + q_offset, tuple(sel)))
     return jnp.concatenate(outs, axis=1).reshape(b, tq, h, dh)
 
 

@@ -283,3 +283,29 @@ def test_eval_batch(devices):
     np.testing.assert_allclose(l_train, l_eval, rtol=1e-4, atol=1e-4)
     # after the update the eval loss moves
     assert abs(float(eng.eval_batch(iter(batches))) - l_eval) > 1e-5
+
+
+def test_save_attn_qkv_remat_policy(devices):
+    """The finer remat policy (attn_out + post-rope q/k/v saved) must
+    resolve and train with the same loss trajectory as save_attn_out
+    (policies change memory/time, never math)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    cfg = llama3_config("tiny", max_seq_len=32, vocab_size=256)
+    batch = {"input_ids": np.asarray(np.random.default_rng(0).integers(
+        0, 256, size=(8, 32)), np.int32)}
+    losses = {}
+    for policy in ("save_attn_out", "save_attn_qkv"):
+        build_mesh(data=8)
+        engine, _, _, _ = ds.initialize(
+            model=cfg,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "activation_checkpointing": {"policy": policy}},
+            rng=jax.random.PRNGKey(0))
+        losses[policy] = [float(engine.train_batch(iter([batch])))
+                          for _ in range(3)]
+    np.testing.assert_allclose(losses["save_attn_out"],
+                               losses["save_attn_qkv"], rtol=1e-5)

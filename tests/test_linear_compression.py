@@ -214,3 +214,38 @@ def test_split_merge_params_quantized_grad():
 
     g = jax.grad(loss)(tr)           # must not raise on int8 base
     assert np.any(np.asarray(g["lora_b"]) != 0.0)
+
+
+def test_fp8_quantized_base():
+    """fp8-e4m3 frozen base (reference fp_quantizer FP8 path): round-trip
+    error bounded by the e4m3 mantissa step, forward close to dense."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+    from deepspeed_tpu.linear.optimized_linear import (
+        apply_optimized_linear, init_optimized_linear)
+    from deepspeed_tpu.ops.quantizer import (dequantize_fp8_blocks,
+                                             quantize_fp8_blocks)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal(4096) * 0.02, jnp.float32)
+    q, s = quantize_fp8_blocks(w, block=256)
+    assert q.dtype == jnp.float8_e4m3fn
+    back = dequantize_fp8_blocks(q, s, block=256)
+    # e4m3: 3 mantissa bits -> worst-case step ~2^-3 of the element's own
+    # magnitude; bound the absolute error by absmax * 2^-3
+    absmax = float(jnp.max(jnp.abs(w)))
+    assert float(jnp.max(jnp.abs(back - w))) < absmax * (2.0 ** -3)
+    # and the error must be RELATIVE, not absolute: small elements keep
+    # small errors (the point of block scaling + float quant)
+    small = jnp.abs(w) < 0.25 * absmax
+    assert float(jnp.max(jnp.abs((back - w) * small))) < \
+        0.25 * absmax * (2.0 ** -3)
+
+    quant = QuantizationConfig(q_dtype="fp8", group_size=64)
+    lora = LoRAConfig(lora_r=4, lora_alpha=8)
+    p = init_optimized_linear(jax.random.PRNGKey(0), 64, 128, lora=lora,
+                              quant=quant)
+    pd = init_optimized_linear(jax.random.PRNGKey(0), 64, 128, lora=lora)
+    x = jnp.asarray(rng.standard_normal((2, 64)), jnp.float32)
+    yq = apply_optimized_linear(p, x, lora=lora, quant=quant)
+    yd = apply_optimized_linear(pd, x, lora=lora)
+    assert float(jnp.max(jnp.abs(yq - yd))) / float(jnp.max(jnp.abs(yd))) < 0.1
