@@ -7,8 +7,11 @@ nn.Linear, shards the frozen base across ranks, and dequantizes in forward;
 here the layer is a pure function over a params pytree:
 
 - ``base`` is FROZEN (``lax.stop_gradient``) and optionally stored
-  block-quantized int8 (ops/quantizer.py) — 4× less HBM than fp32, 2× less
-  than bf16; dequantize fuses into the matmul epilogue under jit.
+  block-quantized — symmetric int8 or block-scaled fp8-e4m3
+  (``QuantizationConfig.q_dtype``; ops/quantizer.py) — 4× less HBM than
+  fp32, 2× less than bf16; dequantize fuses into the matmul epilogue
+  under jit. ``mantissa_bits`` is a parity field only: the fp8 path is
+  e4m3 (fp6 has no native TPU dtype).
 - ``lora_a [r, in]`` / ``lora_b [out, r]`` are the trainable adapters;
   output = x @ baseᵀ + (alpha/r) · x @ lora_aᵀ @ lora_bᵀ.
 - sharding: the base weight's PartitionSpec puts the out-dim on the fsdp
@@ -52,9 +55,10 @@ def init_optimized_linear(rng: jax.Array, in_features: int,
     p: Params = {}
     if quant is not None:
         if quant.q_bits != 8:
-            raise ValueError("OptimizedLinear quantized base supports int8 "
-                             "(reference default); use ops/quantizer "
-                             "directly for int4")
+            raise ValueError(
+                "OptimizedLinear quantized base supports 8-bit storage "
+                "(q_dtype 'int8' or 'fp8'); use ops/quantizer directly "
+                "for int4")
         if quant.q_dtype not in ("int8", "fp8"):
             raise ValueError(f"unknown q_dtype '{quant.q_dtype}'")
         total = out_features * in_features

@@ -138,6 +138,10 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         arch = ["GemmaForCausalLM"]
     elif cfg.num_experts:
         mt, arch = "mixtral", ["MixtralForCausalLM"]
+    elif cfg.use_bias:
+        # qkv biases exist only in the qwen2 layout of this family;
+        # exporting as llama/mistral would silently drop them
+        mt, arch = "qwen2", ["Qwen2ForCausalLM"]
     elif cfg.sliding_window is not None:
         # LlamaConfig has no sliding-window support — exporting SWA as
         # 'llama' would silently reload full-causal in transformers
@@ -161,6 +165,8 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
     }
     if cfg.sliding_window is not None:
         hf["sliding_window"] = cfg.sliding_window
+        if mt == "qwen2":
+            hf["use_sliding_window"] = True   # qwen2 defaults it OFF
     if _is_gemma_layout(cfg):
         # always explicit: GemmaConfig's DEFAULT head_dim is 256, not
         # hidden//heads — an omitted key reloads with the wrong shape
@@ -388,6 +394,16 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
             np.ascontiguousarray(a["wv"][i].T)
         out[p.format(i) + "self_attn.o_proj.weight"] = \
             np.ascontiguousarray(a["wo"][i].T)
+        if "bq" in a:   # qwen2: qkv biases; the HF layout has NO o_proj
+            # bias slot, so a trained nonzero bo cannot round-trip
+            out[p.format(i) + "self_attn.q_proj.bias"] = a["bq"][i]
+            out[p.format(i) + "self_attn.k_proj.bias"] = a["bk"][i]
+            out[p.format(i) + "self_attn.v_proj.bias"] = a["bv"][i]
+            if np.abs(a["bo"][i]).max() > 1e-6:
+                logger.warning(
+                    "export_hf_checkpoint: layer %d o_proj bias is "
+                    "nonzero but the qwen2 HF layout has no slot for it "
+                    "— dropped (logits will differ)", i)
         out[p.format(i) + "input_layernorm.weight"] = lyr["ln1"]["scale"][i]
         out[p.format(i) + "post_attention_layernorm.weight"] = \
             lyr["ln2"]["scale"][i]

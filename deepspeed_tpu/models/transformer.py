@@ -572,9 +572,17 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     return logits
 
 
+#: dense (unchunked, no-remat) logits allowed up to this size only — the
+#: chunk budget below may be larger, but an unchunked CE also KEEPS the
+#: logits for backward, so its cap stays conservative
+_DENSE_LOGITS_BYTES = 128 * 1024 * 1024
+
+
 def _pick_chunk(t: int, b: int, v: int,
-                budget_bytes: Optional[int] = None) -> int:
-    """Largest divisor of T whose fp32 logits chunk fits the budget.
+                budget_bytes: Optional[int] = None,
+                max_chunk: Optional[int] = None) -> int:
+    """Largest divisor of T (≤ max_chunk) whose fp32 logits chunk fits
+    the budget.
 
     The budget trades HBM for MXU shape: too small and the [B·C, D]×[D, V]
     chunk matmul has so few rows the MXU idles (measured on v5e 1.27B/
@@ -585,7 +593,7 @@ def _pick_chunk(t: int, b: int, v: int,
         budget_bytes = int(os.environ.get("DSTPU_CE_BUDGET_MB", 512)) \
             * 1024 * 1024
     best = 1
-    for c in range(1, t + 1):
+    for c in range(1, (max_chunk or t) + 1):
         if t % c == 0 and b * c * v * 4 <= budget_bytes:
             best = c
     return best
@@ -606,6 +614,12 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
     b, t, d = x.shape
     v = cfg.vocab_size
     chunk = chunk_size or _pick_chunk(t, b, v)
+    if chunk >= t and chunk_size is None and \
+            b * t * v * 4 > _DENSE_LOGITS_BYTES:
+        # the whole-T logits fit the CHUNK budget, but an unchunked CE
+        # would also hold them live for backward (no remat) — keep the
+        # scan with at least two chunks instead
+        chunk = _pick_chunk(t, b, v, max_chunk=t // 2)
     if chunk >= t:
         return cross_entropy_loss(lm_logits(cfg, params, x), targets,
                                   ignore_index)

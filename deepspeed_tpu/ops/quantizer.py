@@ -102,7 +102,7 @@ def fp8_cast(x: jax.Array, dtype=jnp.float8_e4m3fn) -> jax.Array:
 _FP8_E4M3_MAX = 448.0
 
 
-def quantize_fp8_blocks(x: jax.Array, block: int = 256):
+def quantize_fp8_blocks(x: jax.Array, block: int = DEFAULT_BLOCK):
     """Block-scaled fp8-e4m3 quantization (reference ops/fp_quantizer
     FP_Quantize with q_bits=8, mantissa_bits=3 — the 'FP6-LLM' family).
 
@@ -110,10 +110,7 @@ def quantize_fp8_blocks(x: jax.Array, block: int = 256):
     range, so small-magnitude weight blocks keep their mantissa precision
     instead of flushing toward zero. Returns (q fp8 [n], scales fp32
     [n/block])."""
-    n = x.size
-    if n % block:
-        raise ValueError(f"size {n} not divisible by block {block}")
-    xb = x.reshape(n // block, block).astype(jnp.float32)
+    xb = _as_blocks(x.astype(jnp.float32), block)
     absmax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
     scale = jnp.maximum(absmax / _FP8_E4M3_MAX, 1e-12)
     q = (xb / scale).astype(jnp.float8_e4m3fn).reshape(-1)
@@ -121,10 +118,9 @@ def quantize_fp8_blocks(x: jax.Array, block: int = 256):
 
 
 def dequantize_fp8_blocks(q: jax.Array, scales: jax.Array,
-                          block: int = 256, dtype=jnp.float32) -> jax.Array:
-    n = q.size
-    xb = q.reshape(n // block, block).astype(jnp.float32) * \
-        scales[:, None]
+                          block: int = DEFAULT_BLOCK,
+                          dtype=jnp.float32) -> jax.Array:
+    xb = _as_blocks(q, block).astype(jnp.float32) * scales[:, None]
     return xb.reshape(-1).astype(dtype)
 
 

@@ -1,0 +1,13 @@
+"""Shared example bootstrap."""
+
+import os
+
+
+def setup_jax():
+    """Import jax honoring the JAX_PLATFORMS env var even when a site
+    hook (e.g. a remote-TPU tunnel plugin) overrides it programmatically —
+    the config knob set after import wins."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    return jax

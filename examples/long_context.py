@@ -1,0 +1,49 @@
+"""Long-context training: Ulysses or ring sequence parallelism.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/long_context.py --sp 4 --mode ulysses --seq 2048
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sp", type=int, default=4)
+    ap.add_argument("--mode", choices=("ulysses", "ring"), default="ulysses")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    from _common import setup_jax
+    jax = setup_jax()
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+
+    n = len(jax.devices())
+    ds.build_mesh(data=n // args.sp, seq=args.sp)
+    model = llama3_config("tiny", max_seq_len=args.seq)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+            "zero_optimization": {"stage": 1},
+            "sequence_parallel": {"size": args.sp, "mode": args.mode},
+        },
+        rng=jax.random.PRNGKey(0))
+
+    gb = int(engine.config.train_batch_size)
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        batch = {"input_ids": rng.integers(
+            0, model.vocab_size, size=(gb, args.seq), dtype=np.int32)}
+        loss = engine.train_batch(iter([batch]))
+        print(f"step {step}: loss {float(loss):.4f} "
+              f"(seq {args.seq} over sp={args.sp} {args.mode})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Serve a model with continuous batching (ragged/paged engine — the
+FastGen analogue) or the simpler padded v1 engine.
+
+    python examples/serve.py --engine ragged --prompts "hello" "the sky"
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--engine", choices=("ragged", "v1"), default="ragged")
+    ap.add_argument("--model-dir", default=None,
+                    help="HF checkpoint dir; random tiny llama if unset")
+    ap.add_argument("--prompts", nargs="+", default=["1 2 3 4"])
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+
+    from _common import setup_jax
+    jax = setup_jax()
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+
+    ds.build_mesh(data=1, devices=jax.devices()[:1])
+    params = None
+    if args.model_dir:
+        from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+        cfg, params = load_hf_checkpoint(args.model_dir)
+        try:
+            from transformers import AutoTokenizer
+            tok = AutoTokenizer.from_pretrained(args.model_dir)
+        except Exception:
+            tok = None
+    else:
+        cfg, tok = llama3_config("tiny", max_seq_len=512), None
+
+    def encode(p):
+        if tok is not None:
+            return tok(p)["input_ids"]
+        return [int(x) % cfg.vocab_size for x in p.split()]
+
+    prompts = [encode(p) for p in args.prompts]
+    if args.engine == "ragged":
+        from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+        eng = RaggedInferenceEngineTPU(cfg, params=params)
+        outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens,
+                            temperature=args.temperature)
+    else:
+        from deepspeed_tpu.inference.engine import InferenceEngineTPU
+        eng = InferenceEngineTPU(cfg, params=params)
+        outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens,
+                            temperature=args.temperature)
+    for p, o in zip(args.prompts, outs):
+        text = tok.decode(o) if tok is not None else " ".join(map(str, o))
+        print(f"> {p}\n{text}\n")
+
+
+if __name__ == "__main__":
+    main()

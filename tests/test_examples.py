@@ -1,0 +1,64 @@
+"""The examples/ scripts must keep working as the public API evolves
+(reference analogue: DeepSpeedExamples smoke coverage in CI)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=280):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_pretrain_example(tmp_path):
+    r = _run(["examples/pretrain.py", "--size", "tiny", "--steps", "3",
+              "--seq", "64"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loss" in r.stdout and "checkpoint saved" in r.stdout
+
+
+def test_serve_example():
+    r = _run(["examples/serve.py", "--engine", "ragged", "--prompts",
+              "1 2 3", "--max-new-tokens", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "1 2 3" in r.stdout
+
+
+def test_long_context_example():
+    r = _run(["examples/long_context.py", "--sp", "4", "--seq", "256",
+              "--steps", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sp=4 ulysses" in r.stdout
+
+
+def test_serve_v1_example():
+    r = _run(["examples/serve.py", "--engine", "v1", "--prompts", "1 2 3",
+              "--max-new-tokens", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "1 2 3" in r.stdout
+
+
+def test_finetune_hf_example(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, vocab_size=256,
+                      max_position_embeddings=128,
+                      attention_bias=False)
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).save_pretrained(str(tmp_path / "hf"),
+                                          safe_serialization=True)
+    out = tmp_path / "export"
+    r = _run(["examples/finetune_hf.py", "--model-dir",
+              str(tmp_path / "hf"), "--steps", "2", "--seq", "32",
+              "--export-dir", str(out)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (out / "model.safetensors").exists()

@@ -239,3 +239,25 @@ def test_export_rejects_unsupported_layout(tmp_path):
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises((ValueError, NotImplementedError)):
         export_hf_checkpoint(cfg, params, str(tmp_path / "nope"))
+
+
+def test_qwen2_export_roundtrip(tmp_path):
+    """Qwen2 layout (qkv biases + optional SWA) must export under
+    model_type qwen2 with the biases intact and reload in transformers
+    with matching logits."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    from deepspeed_tpu.models.qwen2 import qwen2_config
+    cfg = qwen2_config("tiny", vocab_size=256, max_seq_len=128)
+    assert cfg.use_bias
+    params = transformer.init_params(cfg, jax.random.PRNGKey(9))
+    out = tmp_path / "export_qwen2"
+    export_hf_checkpoint(cfg, params, str(out))
+    with open(out / "config.json") as fh:
+        hf_cfg = json.load(fh)
+    assert hf_cfg["model_type"] == "qwen2"
+    reloaded = Qwen2ForCausalLM.from_pretrained(str(out)).eval()
+    tokens = np.arange(3, 19, dtype=np.int32)[None]
+    ours = np.asarray(transformer.forward(cfg, params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = reloaded(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
