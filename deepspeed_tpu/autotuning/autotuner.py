@@ -49,6 +49,8 @@ class Autotuner:
                  batch_fn: Callable[[int], Dict[str, Any]],
                  micro_batch_sizes: Optional[List[int]] = None,
                  zero_stages: Optional[List[int]] = None,
+                 remat_policies: Optional[List[str]] = None,
+                 ce_budgets_mb: Optional[List[int]] = None,
                  steps: int = 5, warmup: int = 2,
                  rng: Optional[jax.Array] = None):
         self.model = model
@@ -56,6 +58,10 @@ class Autotuner:
         self.batch_fn = batch_fn
         self.micro_batch_sizes = micro_batch_sizes or [1, 2, 4, 8]
         self.zero_stages = zero_stages or [2, 3]
+        #: optional extra sweep axes (both proved decisive on the v5e
+        #: bench: remat policy and the chunked-CE logits budget)
+        self.remat_policies = remat_policies or [None]
+        self.ce_budgets_mb = ce_budgets_mb or [None]
         self.steps = steps
         self.warmup = warmup
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -64,29 +70,43 @@ class Autotuner:
     def _candidates(self) -> Iterator[Dict[str, Any]]:
         for stage in self.zero_stages:
             for mbs in self.micro_batch_sizes:
-                cfg = copy.deepcopy(self.base_config)
-                cfg["train_micro_batch_size_per_gpu"] = mbs
-                cfg.pop("train_batch_size", None)
-                cfg.setdefault("zero_optimization", {})["stage"] = stage
-                yield cfg
+                for remat in self.remat_policies:
+                    for ce_mb in self.ce_budgets_mb:
+                        cfg = copy.deepcopy(self.base_config)
+                        cfg["train_micro_batch_size_per_gpu"] = mbs
+                        cfg.pop("train_batch_size", None)
+                        cfg.setdefault("zero_optimization",
+                                       {})["stage"] = stage
+                        if remat is not None:
+                            cfg.setdefault("activation_checkpointing",
+                                           {})["policy"] = remat
+                        if ce_mb is not None:
+                            cfg["chunked_ce_budget_mb"] = ce_mb
+                        yield cfg
 
     def _measure(self, cfg: Dict[str, Any]) -> TuneResult:
         from deepspeed_tpu.parallel.mesh import get_mesh
         from deepspeed_tpu.runtime.engine import initialize
         mbs = cfg["train_micro_batch_size_per_gpu"]
         try:
+            # chunked_ce_budget_mb is a REAL config key, so the winning
+            # config in autotune_best.json reproduces the measured run
+            # when fed straight back to initialize()
             engine, *_ = initialize(model=self.model, config=cfg,
                                     mesh=get_mesh(), rng=self.rng)
             batch = self.batch_fn(mbs)
             gas = int(engine.config.gradient_accumulation_steps)
             it = lambda: iter([batch] * gas)
             for _ in range(self.warmup):
-                engine.train_batch(it())
+                # host fetch, not block_until_ready: remote runtimes
+                # (axon tunnel) only execute on fetch — blocking on the
+                # handle times dispatch, not the step
+                float(engine.train_batch(it()))
             t0 = time.perf_counter()
             loss = None
             for _ in range(self.steps):
                 loss = engine.train_batch(it())
-            jax.block_until_ready(loss)
+            float(loss)
             dt = (time.perf_counter() - t0) / self.steps
             tput = int(engine.config.train_batch_size) / dt
             return TuneResult(config=cfg, throughput=tput, step_time=dt)
@@ -101,9 +121,15 @@ class Autotuner:
         for cfg in self._candidates():
             res = self._measure(cfg)
             self.results.append(res)
+            extras = ""
+            ac = cfg.get("activation_checkpointing", {}).get("policy")
+            if ac:
+                extras += f" remat={ac}"
+            if "chunked_ce_budget_mb" in cfg:
+                extras += f" ce={cfg['chunked_ce_budget_mb']}MB"
             log_dist(
                 f"autotune: mbs={cfg['train_micro_batch_size_per_gpu']} "
-                f"zero={cfg['zero_optimization']['stage']} → "
+                f"zero={cfg['zero_optimization']['stage']}{extras} → "
                 f"{res.throughput:.1f} samples/s"
                 + (f" (FAILED: {res.error[:60]})" if res.error else ""))
         feasible = [r for r in self.results if r.feasible]

@@ -398,6 +398,11 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     #: materializes [T,T] scores (tests/short seqs only)
     attention_impl: str = "auto"
 
+    #: chunked cross-entropy logits budget in MB (None → env
+    #: DSTPU_CE_BUDGET_MB or 512). Bigger chunks feed the MXU better on
+    #: large-vocab logits matmuls; this is the autotuner's ce axis.
+    chunked_ce_budget_mb: Optional[int] = None
+
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False
     dump_state: bool = False

@@ -601,7 +601,8 @@ def _pick_chunk(t: int, b: int, v: int,
 
 def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
                           targets: jax.Array, ignore_index: int = -100,
-                          chunk_size: Optional[int] = None
+                          chunk_size: Optional[int] = None,
+                          budget_bytes: Optional[int] = None
                           ) -> jax.Array:
     """Token-mean CE without materializing [B,T,V] logits.
 
@@ -613,13 +614,13 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
     """
     b, t, d = x.shape
     v = cfg.vocab_size
-    chunk = chunk_size or _pick_chunk(t, b, v)
+    chunk = chunk_size or _pick_chunk(t, b, v, budget_bytes)
     if chunk >= t and chunk_size is None and \
             b * t * v * 4 > _DENSE_LOGITS_BYTES:
         # the whole-T logits fit the CHUNK budget, but an unchunked CE
         # would also hold them live for backward (no remat) — keep the
         # scan with at least two chunks instead
-        chunk = _pick_chunk(t, b, v, max_chunk=t // 2)
+        chunk = _pick_chunk(t, b, v, budget_bytes, max_chunk=t // 2)
     if chunk >= t:
         return cross_entropy_loss(lm_logits(cfg, params, x), targets,
                                   ignore_index)

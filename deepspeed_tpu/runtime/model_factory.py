@@ -142,6 +142,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     attn_fn = select_attention(ds_cfg, dec_cfg)
     moe_fn = select_moe(dec_cfg, ds_cfg)
     remat = ds_cfg.activation_checkpointing.policy
+    ce_budget = None if ds_cfg.chunked_ce_budget_mb is None \
+        else int(ds_cfg.chunked_ce_budget_mb) * 1024 * 1024
 
     def init_fn(rng):
         return transformer.init_params(dec_cfg, rng)
@@ -157,7 +159,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=moe_fn,
             remat_policy=remat)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
-                                                 labels)
+                                                 labels,
+                                                 budget_bytes=ce_budget)
         return loss + aux if moe_fn is not None else loss
 
     tp = ds_cfg.tensor_parallel.enabled
@@ -198,7 +201,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                                   _pipe_labels(tokens, batch),
                                   attn_fn=pipe_attn, moe_fn=moe_fn,
                                   remat_policy=remat or "full",
-                                  num_stages=stages)
+                                  num_stages=stages,
+                                  ce_budget_bytes=ce_budget)
 
         if ds_cfg.pipeline.schedule == "1f1b":
             def pipeline_grad_fn(params, batch, rng, scale):
@@ -206,7 +210,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                 return pipelined_loss_and_grads_1f1b(
                     dec_cfg, params, tokens, _pipe_labels(tokens, batch),
                     scale=scale, attn_fn=pipe_attn, moe_fn=moe_fn,
-                    remat_policy=remat or "full", num_stages=stages)
+                    remat_policy=remat or "full", num_stages=stages,
+                    ce_budget_bytes=ce_budget)
         elif ds_cfg.pipeline.schedule != "gpipe":
             raise ValueError(
                 f"pipeline.schedule must be '1f1b' or 'gpipe', got "

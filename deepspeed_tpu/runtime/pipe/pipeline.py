@@ -95,7 +95,8 @@ def _stage_forward(cfg: DecoderConfig, local_layers, x, sin, cos,
 def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
                    attn_fn=None, moe_fn=None,
                    remat_policy: Optional[str] = None,
-                   mesh=None, num_stages: Optional[int] = None):
+                   mesh=None, num_stages: Optional[int] = None,
+                   ce_budget_bytes: Optional[int] = None):
     """tokens/labels: [M, B, T] stacked microbatches → scalar token-mean CE.
 
     Must be called under jit with ``params['layers']`` sharded over 'pipe'
@@ -157,7 +158,8 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
             norm_params["lm_head"] = head
         xn = transformer._norm(cfg, final_norm, xs)
         loss = transformer.chunked_cross_entropy(
-            cfg, norm_params, xn, labels.reshape(M * b, t))
+            cfg, norm_params, xn, labels.reshape(M * b, t),
+            budget_bytes=ce_budget_bytes)
         aux_all = lax.psum(aux_total, "pipe")
         return loss + aux_all
 
@@ -192,6 +194,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                                   labels, scale=1.0, attn_fn=None,
                                   moe_fn=None,
                                   remat_policy: Optional[str] = None,
+                                  ce_budget_bytes: Optional[int] = None,
                                   mesh=None,
                                   num_stages: Optional[int] = None):
     """One-forward-one-backward pipeline step → (loss, grads).
@@ -255,7 +258,8 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
             if has_head:
                 np_["lm_head"] = hd_
             xn = transformer._norm(cfg, fn_, y)
-            return transformer.chunked_cross_entropy(cfg, np_, xn, lbl)
+            return transformer.chunked_cross_entropy(
+                cfg, np_, xn, lbl, budget_bytes=ce_budget_bytes)
 
         perm_fwd = [(i, (i + 1) % S) for i in range(S)]
         perm_rev = [(i, (i - 1) % S) for i in range(S)]

@@ -114,3 +114,25 @@ def test_nvme_perf_sweep(tmp_path):
     assert out["results"][0]["read_gbps"] > 0
     assert out["results"][0]["write_gbps"] > 0
     assert (tmp_path / "io.json").exists()
+
+
+def test_autotuner_sweeps_remat_and_ce_budget(tmp_path, devices):
+    """The extra sweep axes (remat policy × CE budget) multiply the
+    candidate space and the winning config reports them."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(data=8)
+    base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    tuner = Autotuner(model, base, _batch_fn, micro_batch_sizes=[1],
+                      zero_stages=[2],
+                      remat_policies=["none", "save_attn_out"],
+                      ce_budgets_mb=[64, 256], steps=1, warmup=1)
+    best = tuner.tune(results_dir=str(tmp_path))
+    assert len(tuner.results) == 4
+    assert best.feasible
+    assert best.config["activation_checkpointing"]["policy"] in (
+        "none", "save_attn_out")
+    # a REAL config key: feeding autotune_best.json back to initialize()
+    # reproduces the measured candidate
+    assert best.config["chunked_ce_budget_mb"] in (64, 256)
+    for r in tuner.results:   # infeasible candidates keep the key too
+        assert "chunked_ce_budget_mb" in r.config
