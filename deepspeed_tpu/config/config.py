@@ -401,7 +401,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     #: chunked cross-entropy logits budget in MB (None → env
     #: DSTPU_CE_BUDGET_MB or 512). Bigger chunks feed the MXU better on
     #: large-vocab logits matmuls; this is the autotuner's ce axis.
-    chunked_ce_budget_mb: Optional[int] = None
+    chunked_ce_budget_mb: Optional[int] = Field(default=None, ge=1)
 
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False

@@ -194,9 +194,9 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                                   labels, scale=1.0, attn_fn=None,
                                   moe_fn=None,
                                   remat_policy: Optional[str] = None,
-                                  ce_budget_bytes: Optional[int] = None,
                                   mesh=None,
-                                  num_stages: Optional[int] = None):
+                                  num_stages: Optional[int] = None,
+                                  ce_budget_bytes: Optional[int] = None):
     """One-forward-one-backward pipeline step → (loss, grads).
 
     Reference ``schedule.py:189`` (TrainSchedule): each tick a stage runs

@@ -123,9 +123,15 @@ class ThroughputTimer:
                        (self.global_step_count + 1) % self.steps_per_output == 0)
         if sync is not None and will_report:
             # block only on reporting steps: a per-step sync would stall the
-            # async dispatch pipeline (and adds a host round-trip per step)
+            # async dispatch pipeline (and adds a host round-trip per step).
+            # Scalars are FETCHED, not blocked on — remote runtimes (e.g.
+            # the axon tunnel) only execute on fetch, so block_until_ready
+            # there would time dispatch, not the step
             import jax
-            jax.block_until_ready(sync)
+            if getattr(sync, "size", 0) == 1:
+                jax.device_get(sync)
+            else:
+                jax.block_until_ready(sync)
         self.started = False
         if global_step:
             self.global_step_count += 1

@@ -1,0 +1,63 @@
+"""Long-context proof tests: 16K-token training steps on the virtual
+8-device mesh (the scaled-down stand-in for the BASELINE 'Ulysses SP @
+128K ctx' config — same code path, smaller widths)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import llama3_config
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+SEQ = 16384
+
+
+def _cfg(sp_mode):
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "sequence_parallel": {"size": 8, "mode": sp_mode},
+        "activation_checkpointing": {"policy": "full"},
+    }
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_16k_context_sp_train_step(mode):
+    """One real train step at 16K tokens, sequence sharded 8 ways — loss
+    finite and ≈ ln(V) at random init (catches masking/offset bugs that
+    only appear when each shard's q_offset is nonzero)."""
+    build_mesh(data=1, seq=8)
+    model = llama3_config("tiny", max_seq_len=SEQ, vocab_size=256,
+                          intermediate_size=128)
+    engine, _, _, _ = ds.initialize(model=model, config=_cfg(mode),
+                                    rng=jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 256, size=(1, SEQ), dtype=np.int32)}
+    loss = float(engine.train_batch(iter([batch])))
+    assert np.isfinite(loss)
+    assert abs(loss - np.log(256)) < 0.5, loss
+
+
+def test_16k_fpdt_chunked_attention_matches_reference():
+    """FPDT blockwise attention at 16K tokens == plain attention (run at
+    a width where the dense reference is still computable)."""
+    from deepspeed_tpu.models.transformer import dot_product_attention
+    from deepspeed_tpu.parallel.fpdt import fpdt_attention
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, SEQ, 2, 16)) * 0.1,
+                    jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, SEQ, 2, 16)) * 0.1,
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, SEQ, 2, 16)) * 0.1,
+                    jnp.float32)
+    out = fpdt_attention(q, k, v, chunk=2048)
+    ref = dot_product_attention(q[:, :4096], k[:, :4096], v[:, :4096])
+    # spot-check the first 4K rows (full dense 16K reference would be the
+    # memory blowup FPDT exists to avoid; causality makes the prefix
+    # self-contained)
+    np.testing.assert_allclose(np.asarray(out[:, :4096]), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isfinite(np.asarray(out)).all()
