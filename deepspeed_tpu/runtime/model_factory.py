@@ -179,6 +179,21 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         assert dec_cfg.num_layers % stages == 0, (
             f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
             f"stages {stages}")
+        if tp:
+            # vocab-sharded embeddings inside the partial-manual 'pipe'
+            # region hit an XLA SPMD gather-partitioning CHECK failure;
+            # replicate embed/lm_head across 'model' under PP (vocab ~vd
+            # is small next to the layer stack — the reference keeps
+            # embeddings replicated per pipeline stage too, pipe/module.py
+            # tied layers)
+            from jax.sharding import PartitionSpec as _P
+            def _drop_model(spec):
+                return _P(*(None if a == "model" else a for a in spec))
+            specs["embed"] = jax.tree.map(
+                _drop_model, specs["embed"],
+                is_leaf=lambda x: isinstance(x, _P))
+            if "lm_head" in specs:
+                specs["lm_head"] = _drop_model(specs["lm_head"])
         specs = pipeline_partition_specs(specs, stages)
 
         # the pipeline schedule is itself a shard_map; a nested
