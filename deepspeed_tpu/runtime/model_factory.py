@@ -179,6 +179,15 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         assert dec_cfg.num_layers % stages == 0, (
             f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
             f"stages {stages}")
+        if ds_cfg.sequence_parallel.size > 1:
+            # the SP attention wrappers are shard_maps over 'seq'; nesting
+            # them inside the pipeline's partial-manual 'pipe' region
+            # trips a JAX manual-axes conflict — an honest error beats a
+            # cryptic trace (use PP×TP×DP, or SP without PP)
+            raise ValueError(
+                "pipeline parallelism does not compose with "
+                "sequence_parallel yet; drop one of the two (PP composes "
+                "with TP/DP/ZeRO; SP composes with TP/DP/ZeRO/EP)")
         if tp:
             # vocab-sharded embeddings inside the partial-manual 'pipe'
             # region hit an XLA SPMD gather-partitioning CHECK failure;

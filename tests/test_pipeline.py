@@ -220,3 +220,14 @@ def test_pipeline_tp_dp_composition_matches_dp(devices):
     it = iter(micros)
     piped = [float(e1.train_batch(it)) for _ in range(4)]
     np.testing.assert_allclose(base, piped, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_sp_rejected(devices):
+    """PP + SP is an explicit error, not a cryptic nested-shard_map
+    trace."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    build_mesh(pipe=2, data=2, seq=2)
+    cfg = _cfg(2, 1, 1)
+    cfg["sequence_parallel"] = {"size": 2}
+    with pytest.raises(ValueError, match="does not compose"):
+        initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
