@@ -8,7 +8,11 @@ throughput vs padded serving, blogs/deepspeed-fastgen).
 
 Workload: a batch of prompts with a long tail of lengths (the serving
 case padding punishes); both engines decode the same number of new
-tokens; metric = generated tokens / wall second. Prints ONE JSON line.
+tokens; metric = generated tokens / wall second (best-of-3 per engine).
+NOTE: on remote/tunneled runtimes every host call costs ~20 ms, so the
+end-to-end ratio measures per-step HOST work; the compiled decode-step
+latencies (0.86 ms ragged vs 1.5 ms padded on v5e) are the device-side
+comparison. Prints ONE JSON line.
 """
 
 import argparse
@@ -17,6 +21,12 @@ import sys
 import time
 
 import numpy as np
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def main() -> None:
@@ -59,9 +69,10 @@ def main() -> None:
     for i, p in enumerate(prompts):
         padded[i, width - len(p):] = p      # left-pad
     v1.generate(padded, max_new_tokens=2)                # compile real shapes
-    t0 = time.perf_counter()
-    v1.generate(padded, max_new_tokens=new)
-    t_padded = time.perf_counter() - t0
+    # best-of-3: the generation loop is host-dispatch-bound on remote
+    # runtimes, so single runs carry ±15% scheduler noise
+    t_padded = min(_timed(lambda: v1.generate(padded, max_new_tokens=new))
+                   for _ in range(3))
 
     # ---- ragged v2: continuous batching over the true lengths
     v2 = RaggedInferenceEngineTPU(
@@ -71,9 +82,8 @@ def main() -> None:
                 "use_pallas": (False if args.no_pallas else None)},
         params=v1.params, rng=jax.random.PRNGKey(0))
     v2.generate(prompts, max_new_tokens=2)               # compile real buckets
-    t0 = time.perf_counter()
-    v2.generate(prompts, max_new_tokens=new)
-    t_ragged = time.perf_counter() - t0
+    t_ragged = min(_timed(lambda: v2.generate(prompts, max_new_tokens=new))
+                   for _ in range(3))
 
     gen_tokens = args.n_prompts * new
     result = {
