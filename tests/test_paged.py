@@ -230,3 +230,86 @@ def test_ragged_sampling_modes(devices):
     np.testing.assert_array_equal(s1, s2)       # same rng -> reproducible
     assert len(s1) == len(greedy) == 14
     assert not np.array_equal(s1, greedy)       # sampling actually samples
+
+
+def test_fused_decode_matches_stepwise(devices, monkeypatch):
+    """The fused on-device decode loop must produce token-for-token the
+    same output as the stepwise loop (argmax and sampled modes; the
+    sampled comparison pins the device RNG via a fresh engine)."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    from deepspeed_tpu.models.transformer import init_params
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(0, 256, size=(n,), dtype=np.int32)
+               for n in (7, 19)]
+    eng_cfg = {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+               "max_seq_len": 96, "prefill_chunk": 8,
+               "max_batch_tokens": 64}
+
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 0.8, "top_k": 8},
+                   {"temperature": 0.7, "top_p": 0.9}):
+        fused_eng = RaggedInferenceEngineTPU(
+            cfg, eng_cfg, params=params, rng=jax.random.PRNGKey(1))
+        fused = fused_eng.generate(prompts, max_new_tokens=8, **kwargs)
+
+        monkeypatch.setenv("DSTPU_NO_FUSED_DECODE", "1")
+        step_eng = RaggedInferenceEngineTPU(
+            cfg, eng_cfg, params=params, rng=jax.random.PRNGKey(1))
+        stepwise = step_eng.generate(prompts, max_new_tokens=8, **kwargs)
+        monkeypatch.delenv("DSTPU_NO_FUSED_DECODE")
+
+        for f, s in zip(fused, stepwise):
+            np.testing.assert_array_equal(f, s)
+
+
+def test_fused_decode_eos_truncation(devices):
+    """With eos_token_id set the fused loop truncates on host; outputs
+    end at (and include) the first eos."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    eng = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+              "max_seq_len": 96, "prefill_chunk": 8,
+              "max_batch_tokens": 64}, rng=jax.random.PRNGKey(2))
+    prompt = [1, 2, 3]
+    outs = eng.generate([prompt], max_new_tokens=12, eos_token_id=None)
+    # pick the token generated at step 3 as the fake eos: rerun with it
+    fake_eos = int(outs[0][len(prompt) + 3])
+    eng2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+              "max_seq_len": 96, "prefill_chunk": 8,
+              "max_batch_tokens": 64}, params=eng.params,
+        rng=jax.random.PRNGKey(2))
+    outs2 = eng2.generate([prompt], max_new_tokens=12,
+                          eos_token_id=fake_eos)
+    assert outs2[0][-1] == fake_eos
+    assert len(outs2[0]) <= len(outs[0])
+    np.testing.assert_array_equal(outs2[0], outs[0][:len(outs2[0])])
+
+
+def test_fused_decode_falls_back_when_unavailable(devices, monkeypatch):
+    """When pre-allocation can't cover the decode window, generate()
+    falls back to the stepwise loop instead of failing."""
+    from deepspeed_tpu.inference.engine_v2 import FusedDecodeUnavailable
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    eng = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+              "max_seq_len": 64, "prefill_chunk": 8,
+              "max_batch_tokens": 64}, rng=jax.random.PRNGKey(0))
+    # the real raise: window overruns max_seq_len
+    eng.state.extend(99, list(range(10)))
+    with pytest.raises(FusedDecodeUnavailable, match="tokens"):
+        eng._fused_decode([99], [1], steps=60, mode=("argmax",))
+    eng.flush(99)
+
+    # end-to-end: force the fast path to decline and check the stepwise
+    # loop still produces the full output
+    monkeypatch.setattr(
+        eng, "_fused_decode",
+        lambda *a, **k: (_ for _ in ()).throw(
+            FusedDecodeUnavailable("forced")))
+    outs = eng.generate([[1, 2, 3]], max_new_tokens=8)
+    assert len(outs[0]) == 11
