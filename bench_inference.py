@@ -68,7 +68,7 @@ def main() -> None:
     padded = np.zeros((args.n_prompts, width), np.int32)
     for i, p in enumerate(prompts):
         padded[i, width - len(p):] = p      # left-pad
-    v1.generate(padded, max_new_tokens=2)                # compile real shapes
+    v1.generate(padded, max_new_tokens=new)              # compile real shapes
     # best-of-3: the generation loop is host-dispatch-bound on remote
     # runtimes, so single runs carry ±15% scheduler noise
     t_padded = min(_timed(lambda: v1.generate(padded, max_new_tokens=new))
@@ -77,11 +77,11 @@ def main() -> None:
     # ---- ragged v2: continuous batching over the true lengths
     v2 = RaggedInferenceEngineTPU(
         model, {"dtype": dtype, "num_blocks": 512, "block_size": 64,
-                "max_seq_len": seq_cap, "prefill_chunk": 256,
-                "max_batch_tokens": 2048,
+                "max_seq_len": seq_cap, "prefill_chunk": 512,
+                "max_batch_tokens": 4096,
                 "use_pallas": (False if args.no_pallas else None)},
         params=v1.params, rng=jax.random.PRNGKey(0))
-    v2.generate(prompts, max_new_tokens=2)               # compile real buckets
+    v2.generate(prompts, max_new_tokens=new)             # compile real buckets
     t_ragged = min(_timed(lambda: v2.generate(prompts, max_new_tokens=new))
                    for _ in range(3))
 

@@ -16,8 +16,11 @@ from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional, Tuple, Union
 
+import os
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -127,8 +130,58 @@ class InferenceEngineTPU:
             partial(forward_with_cache, model, moe_fn=self._moe_fn),
             donate_argnums=(2,))
         self._samplers: Dict[Tuple[float, int, float], Any] = {}
+        #: fused decode-loop jit cache; scan lengths bucket to 32s so
+        #: varying max_new_tokens share compiles
+        self._fused_fns: Dict[Any, Any] = {}
         log_dist(f"inference engine ready: tp={self.mesh.shape['model']} "
                  f"dtype={config.dtype} max_out={config.max_out_tokens}")
+
+    _FUSED_STEP_BUCKET = 32
+
+    def _fused_gen_fn(self, sb: int, mode):
+        """jit: up to `sb` decode iterations in ONE device program (same
+        trick as the ragged engine's fused loop — kills the 2+ host
+        round-trips per token of the stepwise path). `mode` is the STATIC
+        sampling shape; temperature/top_p are traced operands so
+        per-request values don't recompile. NOTE: iterations beyond the
+        requested step count (bucket padding) still run; their clamped
+        `dynamic_update_slice` writes land IN the final cache slot — the
+        cache is CORRUPT after this fn and must be discarded (outputs are
+        correct because the live ys are emitted before those writes)."""
+        key = (sb, mode)
+        if key in self._fused_fns:
+            return self._fused_fns[key]
+        from deepspeed_tpu.inference.engine_v2 import _sample_tokens
+        model = self.model_config
+        moe_fn = self._moe_fn
+
+        def fn(params, first, cache, start_len, temp, top_p, rng):
+            def body(carry, i):
+                tokens, cache, rng = carry
+                logits, cache = forward_with_cache(
+                    model, params, tokens[:, None], cache, start_len + i,
+                    moe_fn=moe_fn)
+                nxt, rng = _sample_tokens(logits, mode, temp, top_p, rng)
+                return (nxt, cache, rng), nxt
+
+            (_, cache, _), ys = lax.scan(
+                body, (first, cache, rng),
+                jnp.arange(sb, dtype=jnp.int32))
+            return ys
+
+        jitted = jax.jit(fn, donate_argnums=(2,))
+        self._fused_fns[key] = jitted
+        return jitted
+
+    def _first_sampler(self, mode):
+        """Sample the prefill logits with traced temperature/top_p (one
+        compile per static mode, not per value)."""
+        key = ("first", mode)
+        if key not in self._fused_fns:
+            from deepspeed_tpu.inference.engine_v2 import _sample_tokens
+            self._fused_fns[key] = jax.jit(
+                lambda lg, t, p, r: _sample_tokens(lg, mode, t, p, r)[0])
+        return self._fused_fns[key]
 
     def _sampler(self, temperature: float, top_k: int, top_p: float):
         """jit cache keyed on sampling params (a fresh jit(partial(...))
@@ -167,6 +220,11 @@ class InferenceEngineTPU:
         tokens = jnp.asarray(input_ids)
         logits, cache = self._step(self.params, tokens, cache,
                                    jnp.int32(0))
+        if max_new_tokens > 1 and \
+                not os.environ.get("DSTPU_NO_FUSED_DECODE"):
+            return self._generate_fused(input_ids, logits, cache,
+                                        max_new_tokens, temperature,
+                                        top_k, top_p, eos_token_id, rng)
         out = [input_ids]
         done = np.zeros((b,), bool)
         cur_len = t
@@ -195,6 +253,32 @@ class InferenceEngineTPU:
                           np.int32)
             result = np.concatenate([result, pad], axis=1)
         return result
+
+    def _generate_fused(self, input_ids, logits, cache, max_new_tokens,
+                        temperature, top_k, top_p, eos_token_id, rng):
+        """Decode loop as one device program; eos handled by host-side
+        truncation of the fetched token matrix (the full window runs on
+        device — latency traded for the removed per-token round-trips)."""
+        b, t = input_ids.shape
+        steps = max_new_tokens - 1
+        sb = -(-steps // self._FUSED_STEP_BUCKET) * self._FUSED_STEP_BUCKET
+        mode = ("argmax",) if temperature == 0.0 \
+            else ("sample", int(top_k), top_p < 1.0)
+        temp = jnp.float32(temperature if temperature else 1.0)
+        tp = jnp.float32(top_p)
+        rng, sub, loop_rng = jax.random.split(rng, 3)
+        first = self._first_sampler(mode)(logits, temp, tp, sub)
+        ys = self._fused_gen_fn(sb, mode)(
+            self.params, first, cache, jnp.int32(t), temp, tp, loop_rng)
+        gen = np.concatenate(
+            [np.asarray(jax.device_get(first))[None],
+             np.asarray(jax.device_get(ys))[:steps]], axis=0).T  # [B, new]
+        if eos_token_id is not None:
+            seen = np.cumsum(gen == eos_token_id, axis=1)
+            # positions strictly after the first eos become eos
+            gen = np.where(seen - (gen == eos_token_id) > 0,
+                           eos_token_id, gen)
+        return np.concatenate([input_ids, gen.astype(np.int32)], axis=1)
 
     def forward(self, input_ids) -> jax.Array:
         """Full-sequence logits (no cache) — parity with engine forward."""

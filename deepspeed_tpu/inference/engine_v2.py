@@ -541,19 +541,28 @@ class RaggedInferenceEngineTPU:
                             break
                     self.flush(u)
                 return [np.asarray(seqs[u], np.int32) for u in uids]
-        while pending:
-            active_uids, toks = [], []
-            for u, t in list(pending.items()):
-                seqs[u].append(t)
-                remaining[u] -= 1
-                if remaining[u] <= 0 or (eos_token_id is not None
-                                         and t == eos_token_id):
+        try:
+            while pending:
+                active_uids, toks = [], []
+                for u, t in list(pending.items()):
+                    seqs[u].append(t)
+                    remaining[u] -= 1
+                    if remaining[u] <= 0 or (eos_token_id is not None
+                                             and t == eos_token_id):
+                        self.flush(u)
+                        del pending[u]
+                    else:
+                        active_uids.append(u)
+                        toks.append([t])
+                if not active_uids:
+                    break
+                pending = self._put_tokens(active_uids, toks, mode)
+        except Exception:
+            # mid-loop failures (arena exhausted, over-length) must not
+            # leak this call's sequences — their pages/slots would be
+            # lost to every later request
+            for u in uids:
+                if u in self.state.seqs:
                     self.flush(u)
-                    del pending[u]
-                else:
-                    active_uids.append(u)
-                    toks.append([t])
-            if not active_uids:
-                break
-            pending = self._put_tokens(active_uids, toks, mode)
+            raise
         return [np.asarray(seqs[u], np.int32) for u in uids]

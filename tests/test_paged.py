@@ -313,3 +313,25 @@ def test_fused_decode_falls_back_when_unavailable(devices, monkeypatch):
             FusedDecodeUnavailable("forced")))
     outs = eng.generate([[1, 2, 3]], max_new_tokens=8)
     assert len(outs[0]) == 11
+
+
+def test_stepwise_failure_does_not_leak_pages(devices):
+    """If the stepwise loop dies mid-generation (arena exhausted), the
+    call's sequences must be flushed — leaked pages would shrink capacity
+    for every later request."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    eng = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 4, "block_size": 16,
+              "max_seq_len": 128, "prefill_chunk": 8,
+              "max_batch_tokens": 64}, rng=jax.random.PRNGKey(0))
+    free_before = eng.state.allocator.free_blocks
+    # 2 prompts x (14 + 60) tokens needs more than 4x16 pages; fused
+    # declines on capacity, the stepwise loop exhausts the arena mid-run
+    # (eos never fires for a random model with eos_token_id=255 unlikely
+    # early... use an id outside the sampled range to be sure)
+    with pytest.raises(RuntimeError, match="arena"):
+        eng.generate([[1] * 14, [2] * 14], max_new_tokens=60,
+                     eos_token_id=257)
+    assert not eng.state.seqs
+    assert eng.state.allocator.free_blocks == free_before
