@@ -20,7 +20,7 @@ Key design translation for TPU:
 
 import json
 from enum import Enum
-from typing import Any, Dict, List, Optional, Union
+from typing import Literal, Any, Dict, List, Optional, Union
 
 from pydantic import Field, model_validator
 
@@ -402,6 +402,10 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     #: DSTPU_CE_BUDGET_MB or 512). Bigger chunks feed the MXU better on
     #: large-vocab logits matmuls; this is the autotuner's ce axis.
     chunked_ce_budget_mb: Optional[int] = Field(default=None, ge=1)
+    #: 'bf16' emits chunked-CE logits in bf16 (fp32 MXU accumulation is
+    #: kept; only the [B,C,V] HBM roundtrip halves). Default fp32.
+    ce_logits_dtype: Optional[Literal["fp32", "float32", "bf16",
+                                      "bfloat16"]] = None
 
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False

@@ -98,7 +98,7 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
     if impl == "pallas_flash" or (impl == "auto" and on_tpu and
                                   not os.environ.get("DSTPU_NO_PALLAS_ATTN")):
         # mesh-aware Pallas flash kernel — the TPU default: measured
-        # 56.1% (512-element blocks, 512 MB CE budget) vs 45.5% MFU for the chunked-XLA
+        # 56.7% (512-element blocks, 512 MB CE budget, bf16 chunk logits) vs 45.5% MFU for the chunked-XLA
         # path on the 1.27B seq-2048 bench (v5e); shard_map head-sharding over
         # ('model','seq') IS the Ulysses all-to-all when sp > 1.
         # Unsupported shapes fall back inside flash_attention_sharded.
@@ -144,6 +144,10 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     remat = ds_cfg.activation_checkpointing.policy
     ce_budget = None if ds_cfg.chunked_ce_budget_mb is None \
         else int(ds_cfg.chunked_ce_budget_mb) * 1024 * 1024
+    # values validated by the config model (Literal)
+    ce_dtype = jnp.bfloat16 if ds_cfg.ce_logits_dtype in ("bf16",
+                                                          "bfloat16") \
+        else None
 
     def init_fn(rng):
         return transformer.init_params(dec_cfg, rng)
@@ -160,7 +164,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             remat_policy=remat)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
                                                  labels,
-                                                 budget_bytes=ce_budget)
+                                                 budget_bytes=ce_budget,
+                                                 logits_dtype=ce_dtype)
         return loss + aux if moe_fn is not None else loss
 
     tp = ds_cfg.tensor_parallel.enabled
@@ -226,7 +231,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                                   attn_fn=pipe_attn, moe_fn=moe_fn,
                                   remat_policy=remat or "full",
                                   num_stages=stages,
-                                  ce_budget_bytes=ce_budget)
+                                  ce_budget_bytes=ce_budget,
+                                  ce_logits_dtype=ce_dtype)
 
         if ds_cfg.pipeline.schedule == "1f1b":
             def pipeline_grad_fn(params, batch, rng, scale):
@@ -235,7 +241,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                     dec_cfg, params, tokens, _pipe_labels(tokens, batch),
                     scale=scale, attn_fn=pipe_attn, moe_fn=moe_fn,
                     remat_policy=remat or "full", num_stages=stages,
-                    ce_budget_bytes=ce_budget)
+                    ce_budget_bytes=ce_budget, ce_logits_dtype=ce_dtype)
         elif ds_cfg.pipeline.schedule != "gpipe":
             raise ValueError(
                 f"pipeline.schedule must be '1f1b' or 'gpipe', got "

@@ -96,7 +96,8 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
                    attn_fn=None, moe_fn=None,
                    remat_policy: Optional[str] = None,
                    mesh=None, num_stages: Optional[int] = None,
-                   ce_budget_bytes: Optional[int] = None):
+                   ce_budget_bytes: Optional[int] = None,
+                   ce_logits_dtype=None):
     """tokens/labels: [M, B, T] stacked microbatches → scalar token-mean CE.
 
     Must be called under jit with ``params['layers']`` sharded over 'pipe'
@@ -159,7 +160,7 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         xn = transformer._norm(cfg, final_norm, xs)
         loss = transformer.chunked_cross_entropy(
             cfg, norm_params, xn, labels.reshape(M * b, t),
-            budget_bytes=ce_budget_bytes)
+            budget_bytes=ce_budget_bytes, logits_dtype=ce_logits_dtype)
         aux_all = lax.psum(aux_total, "pipe")
         return loss + aux_all
 
@@ -196,7 +197,8 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                                   remat_policy: Optional[str] = None,
                                   mesh=None,
                                   num_stages: Optional[int] = None,
-                                  ce_budget_bytes: Optional[int] = None):
+                                  ce_budget_bytes: Optional[int] = None,
+                                  ce_logits_dtype=None):
     """One-forward-one-backward pipeline step → (loss, grads).
 
     Reference ``schedule.py:189`` (TrainSchedule): each tick a stage runs
@@ -259,7 +261,8 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                 np_["lm_head"] = hd_
             xn = transformer._norm(cfg, fn_, y)
             return transformer.chunked_cross_entropy(
-                cfg, np_, xn, lbl, budget_bytes=ce_budget_bytes)
+                cfg, np_, xn, lbl, budget_bytes=ce_budget_bytes,
+                logits_dtype=ce_logits_dtype)
 
         perm_fwd = [(i, (i + 1) % S) for i in range(S)]
         perm_rev = [(i, (i - 1) % S) for i in range(S)]

@@ -309,3 +309,36 @@ def test_save_attn_qkv_remat_policy(devices):
                           for _ in range(3)]
     np.testing.assert_allclose(losses["save_attn_out"],
                                losses["save_attn_qkv"], rtol=1e-5)
+
+
+def test_ce_bf16_logits_close_to_fp32(devices):
+    """ce_logits_dtype=bf16 must track the fp32 path closely (same data,
+    same init): per-step losses within bf16 rounding of the logits."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=512)
+    batch = {"input_ids": np.asarray(np.random.default_rng(0).integers(
+        0, 512, size=(8, 64)), np.int32)}
+    losses = {}
+    for dt in (None, "bf16"):
+        build_mesh(data=8)
+        engine, _, _, _ = ds.initialize(
+            model=cfg,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "ce_logits_dtype": dt,
+                    # force the chunked path (dense small-logits shortcut
+                    # would bypass the dtype knob)
+                    "chunked_ce_budget_mb": 1},
+            rng=jax.random.PRNGKey(0))
+        losses[dt] = [float(engine.train_batch(iter([batch])))
+                      for _ in range(3)]
+    np.testing.assert_allclose(losses[None], losses["bf16"], rtol=5e-3)
+    with pytest.raises(ValueError, match="ce_logits_dtype"):
+        ds.initialize(model=cfg,
+                      config={"train_micro_batch_size_per_gpu": 1,
+                              "optimizer": {"type": "adamw",
+                                            "params": {"lr": 1e-3}},
+                              "ce_logits_dtype": "fp8"},
+                      rng=jax.random.PRNGKey(0))
