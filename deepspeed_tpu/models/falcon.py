@@ -19,7 +19,7 @@ def falcon_config(size: str = "7b", **overrides) -> DecoderConfig:
                     parallel_block_norms=2),
     }
     base = dict(vocab_size=65024, max_seq_len=2048, norm="layernorm",
-                activation="gelu", pos_emb="rope", rope_theta=10000.0,
+                activation="gelu_exact", pos_emb="rope", rope_theta=10000.0,
                 use_bias=False, norm_bias=True,   # LNs keep bias; linears do not
                 tie_embeddings=True, parallel_block=True)
     base.update(presets[size])

@@ -18,7 +18,7 @@ def gptneox_config(size: str = "20b", **overrides) -> DecoderConfig:
                     intermediate_size=24576),
     }
     base = dict(vocab_size=50432, max_seq_len=2048, norm="layernorm",
-                activation="gelu", pos_emb="rope", rope_theta=10000.0,
+                activation="gelu_exact", pos_emb="rope", rope_theta=10000.0,
                 rotary_pct=0.25, use_bias=True, tie_embeddings=False,
                 # NeoX parallel residual uses SEPARATE input/post_attention
                 # norms on x (HF use_parallel_residual)

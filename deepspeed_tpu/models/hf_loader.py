@@ -31,7 +31,18 @@ Params = Any
 # ---------------------------------------------------------------------------
 
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox",
-              "gemma")
+              "gemma", "gpt2", "opt", "bloom", "falcon", "phi")
+
+
+def _map_hf_act(act: str) -> str:
+    """HF activation_function → DecoderConfig.activation. HF 'gelu' is
+    the exact erf form; 'gelu_new'/'gelu_fast'/'gelu_pytorch_tanh' are
+    the tanh approximation this repo calls plain 'gelu'."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu", "gelu_fast": "gelu",
+             "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+    if act not in table:
+        raise ValueError(f"unsupported HF activation_function '{act}'")
+    return table[act]
 
 
 def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
@@ -48,7 +59,9 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             intermediate_size=hf["intermediate_size"],
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
-            norm="layernorm", activation="gelu", pos_emb="rope",
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("hidden_act", "gelu")),
+            pos_emb="rope",
             rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
             rotary_pct=float(hf.get("rotary_pct", 0.25)),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
@@ -56,6 +69,98 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             parallel_block=bool(hf.get("use_parallel_residual", True)),
             parallel_block_norms=2)
+    if mt == "gpt2":
+        return DecoderConfig(
+            hidden_size=hf["n_embd"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("n_positions", 1024),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation_function",
+                                          "gelu_new")),
+            pos_emb="learned",
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+    if mt == "opt":
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("OPT post-norm variants (do_layer_norm_before="
+                             "False, e.g. opt-350m) are not supported")
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                             "(opt-350m projection) is not supported")
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["ffn_dim"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation_function", "relu")),
+            pos_emb="learned", use_bias=bool(hf.get("enable_bias", True)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+    if mt == "bloom":
+        d = hf.get("hidden_size") or hf["n_embed"]
+        return DecoderConfig(
+            hidden_size=d,
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            intermediate_size=4 * d,
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("seq_length", 2048),
+            norm="layernorm", activation="gelu", pos_emb="alibi",
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=True, embed_norm=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+    if mt == "falcon":
+        new_arch = bool(hf.get("new_decoder_architecture", False))
+        H = hf["num_attention_heads"]
+        if new_arch:
+            kv = hf.get("num_kv_heads") or H
+            norms = hf.get("num_ln_in_parallel_attn") or 2
+        else:
+            kv = 1 if hf.get("multi_query", True) else H
+            norms = 1
+        if not hf.get("parallel_attn", True):
+            raise ValueError("falcon parallel_attn=False (falcon-rw) "
+                             "layout is not supported")
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=H, num_kv_heads=kv,
+            intermediate_size=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu_exact",
+            pos_emb="alibi" if hf.get("alibi") else "rope",
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=bool(hf.get("bias", False)), norm_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            parallel_block=True, parallel_block_norms=norms)
+    if mt == "phi":
+        if hf.get("qk_layernorm"):
+            raise ValueError("phi qk_layernorm=True is not supported")
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads")
+            or hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", pos_emb="rope",
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rotary_pct=float(hf.get("partial_rotary_factor", 0.5)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            use_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            lm_head_bias=True,
+            parallel_block=True, parallel_block_norms=1)
     kw = dict(
         hidden_size=hf["hidden_size"],
         num_layers=hf["num_hidden_layers"],
@@ -104,7 +209,7 @@ def _is_neox_layout(cfg: DecoderConfig) -> bool:
     sequential NeoX still has the layernorm+bias+gelu+rope layout that the
     llama mapping can't express)."""
     return (cfg.norm == "layernorm" and cfg.pos_emb == "rope"
-            and cfg.use_bias and cfg.activation == "gelu"
+            and cfg.use_bias and cfg.activation in ("gelu", "gelu_exact")
             and cfg.has_ln2)   # 1-norm parallel models (phi) are NOT neox
 
 
@@ -124,6 +229,10 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             "layer_norm_eps": cfg.norm_eps,
             "use_parallel_residual": cfg.parallel_block,
             "tie_word_embeddings": cfg.tie_embeddings,
+            # HF "gelu" is the exact erf form; tanh-approx models must
+            # export gelu_new or transformers reloads with the wrong act
+            "hidden_act": ("gelu" if cfg.activation == "gelu_exact"
+                           else "gelu_new"),
             "torch_dtype": "float32",
         }
     if not (cfg.norm == "rmsnorm" and cfg.pos_emb == "rope"
@@ -222,8 +331,19 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
     cfg = config_from_hf(hf_cfg)
     get, names = _reader(model_dir)
     L = cfg.num_layers
-    if hf_cfg.get("model_type") == "gpt_neox":
+    mt = hf_cfg.get("model_type")
+    if mt == "gpt_neox":
         return cfg, _load_neox(cfg, get, dtype)
+    if mt == "gpt2":
+        return cfg, _load_gpt2(cfg, get, names, dtype)
+    if mt == "opt":
+        return cfg, _load_opt(cfg, get, names, dtype)
+    if mt == "bloom":
+        return cfg, _load_bloom(cfg, get, names, dtype)
+    if mt == "falcon":
+        return cfg, _load_falcon(cfg, hf_cfg, get, names, dtype)
+    if mt == "phi":
+        return cfg, _load_phi(cfg, get, dtype)
 
     def T(name):
         return np.ascontiguousarray(get(name).astype(dtype).T)
@@ -351,6 +471,285 @@ def _load_neox(cfg: DecoderConfig, get, dtype) -> Params:
         params["lm_head"] = np.ascontiguousarray(
             get("embed_out.weight").astype(dtype).T)
     return params
+
+
+def _attach_untied_head(params: Params, cfg: DecoderConfig, get, names,
+                        dtype) -> Params:
+    """Untied fine-tunes of normally-tied families (GPT-2/BLOOM/Falcon)
+    carry an explicit lm_head.weight; a config/params mismatch here would
+    crash later in lm_logits with a bare KeyError."""
+    if cfg.tie_embeddings:
+        return params
+    if "lm_head.weight" not in names:
+        raise ValueError("checkpoint says tie_word_embeddings=False but "
+                         "has no lm_head.weight tensor")
+    params["lm_head"] = np.ascontiguousarray(
+        get("lm_head.weight").astype(dtype).T)
+    return params
+
+
+def _stack_helpers(get, L, dtype):
+    """(stack, stackT) over per-layer tensor names."""
+    def stack(fmt):
+        return np.stack([get(fmt.format(i)).astype(dtype)
+                         for i in range(L)])
+
+    def stackT(fmt):
+        return np.stack([np.ascontiguousarray(
+            get(fmt.format(i)).astype(dtype).T) for i in range(L)])
+    return stack, stackT
+
+
+def _load_gpt2(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """GPT-2 layout: Conv1D weights already [in, out]; fused c_attn with
+    COLUMN-CONCATENATED q|k|v (not head-interleaved), learned positions."""
+    L, D = cfg.num_layers, cfg.hidden_size
+    p = "transformer.h.{}."
+    stack, _ = _stack_helpers(get, L, dtype)
+
+    def split_cols(fmt, axis):
+        full = np.stack([get(fmt.format(i)).astype(dtype)
+                         for i in range(L)])
+        return np.split(full, 3, axis=axis)
+
+    qw, kw_, vw = split_cols(p + "attn.c_attn.weight", axis=2)
+    qb, kb, vb = split_cols(p + "attn.c_attn.bias", axis=1)
+    layers = {
+        "attn": {
+            "wq": np.ascontiguousarray(qw), "wk": np.ascontiguousarray(kw_),
+            "wv": np.ascontiguousarray(vw),
+            "wo": stack(p + "attn.c_proj.weight"),
+            "bq": np.ascontiguousarray(qb), "bk": np.ascontiguousarray(kb),
+            "bv": np.ascontiguousarray(vb),
+            "bo": stack(p + "attn.c_proj.bias"),
+        },
+        "ln1": {"scale": stack(p + "ln_1.weight"),
+                "bias": stack(p + "ln_1.bias")},
+        "ln2": {"scale": stack(p + "ln_2.weight"),
+                "bias": stack(p + "ln_2.bias")},
+        "mlp": {
+            "wi": stack(p + "mlp.c_fc.weight"),
+            "bi": stack(p + "mlp.c_fc.bias"),
+            "wo": stack(p + "mlp.c_proj.weight"),
+            "bo": stack(p + "mlp.c_proj.bias"),
+        },
+    }
+    return _attach_untied_head({
+        "embed": {"tokens": get("transformer.wte.weight").astype(dtype),
+                  "pos": get("transformer.wpe.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dtype),
+            "bias": get("transformer.ln_f.bias").astype(dtype)},
+    }, cfg, get, names, dtype)
+
+
+def _load_opt(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """OPT layout: separate q/k/v/out projections with biases, ReLU MLP,
+    learned positions with the +2 row offset (embed_positions stores
+    max_position_embeddings + 2 rows; dense sequences index position+2,
+    so the table is loaded with the first two rows dropped)."""
+    L = cfg.num_layers
+    p = "model.decoder.layers.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+    layers = {
+        "attn": {
+            "wq": stackT(p + "self_attn.q_proj.weight"),
+            "wk": stackT(p + "self_attn.k_proj.weight"),
+            "wv": stackT(p + "self_attn.v_proj.weight"),
+            "wo": stackT(p + "self_attn.out_proj.weight"),
+            "bq": stack(p + "self_attn.q_proj.bias"),
+            "bk": stack(p + "self_attn.k_proj.bias"),
+            "bv": stack(p + "self_attn.v_proj.bias"),
+            "bo": stack(p + "self_attn.out_proj.bias"),
+        },
+        "ln1": {"scale": stack(p + "self_attn_layer_norm.weight"),
+                "bias": stack(p + "self_attn_layer_norm.bias")},
+        "ln2": {"scale": stack(p + "final_layer_norm.weight"),
+                "bias": stack(p + "final_layer_norm.bias")},
+        "mlp": {
+            "wi": stackT(p + "fc1.weight"), "bi": stack(p + "fc1.bias"),
+            "wo": stackT(p + "fc2.weight"), "bo": stack(p + "fc2.bias"),
+        },
+    }
+    params: Params = {
+        "embed": {
+            "tokens": get("model.decoder.embed_tokens.weight").astype(dtype),
+            "pos": get("model.decoder.embed_positions.weight"
+                       ).astype(dtype)[2:],
+        },
+        "layers": layers,
+        "final_norm": {
+            "scale": get("model.decoder.final_layer_norm.weight").astype(dtype),
+            "bias": get("model.decoder.final_layer_norm.bias").astype(dtype)},
+    }
+    return _attach_untied_head(params, cfg, get, names, dtype)
+
+
+def _load_bloom(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """BLOOM layout: NeoX-style HEAD-INTERLEAVED fused query_key_value
+    ([H, 3, dh] on the out dim), word-embeddings LayerNorm, ALiBi (no
+    positional parameters)."""
+    L, H, dh = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    p = "transformer.h.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+
+    def split_qkv(i):
+        w = get(p.format(i) + "self_attention.query_key_value.weight")
+        w = w.astype(dtype).reshape(H, 3, dh, cfg.hidden_size)
+        b = get(p.format(i) + "self_attention.query_key_value.bias")
+        b = b.astype(dtype).reshape(H, 3, dh)
+        return ([np.ascontiguousarray(w[:, j].reshape(H * dh, -1).T)
+                 for j in range(3)],
+                [b[:, j].reshape(-1) for j in range(3)])
+
+    ws, bs = zip(*(split_qkv(i) for i in range(L)))
+    layers = {
+        "attn": {
+            "wq": np.stack([w[0] for w in ws]),
+            "wk": np.stack([w[1] for w in ws]),
+            "wv": np.stack([w[2] for w in ws]),
+            "wo": stackT(p + "self_attention.dense.weight"),
+            "bq": np.stack([b[0] for b in bs]),
+            "bk": np.stack([b[1] for b in bs]),
+            "bv": np.stack([b[2] for b in bs]),
+            "bo": stack(p + "self_attention.dense.bias"),
+        },
+        "ln1": {"scale": stack(p + "input_layernorm.weight"),
+                "bias": stack(p + "input_layernorm.bias")},
+        "ln2": {"scale": stack(p + "post_attention_layernorm.weight"),
+                "bias": stack(p + "post_attention_layernorm.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.dense_h_to_4h.weight"),
+            "bi": stack(p + "mlp.dense_h_to_4h.bias"),
+            "wo": stackT(p + "mlp.dense_4h_to_h.weight"),
+            "bo": stack(p + "mlp.dense_4h_to_h.bias"),
+        },
+    }
+    return _attach_untied_head({
+        "embed": {"tokens":
+                  get("transformer.word_embeddings.weight").astype(dtype)},
+        "embed_norm": {
+            "scale": get("transformer.word_embeddings_layernorm.weight"
+                         ).astype(dtype),
+            "bias": get("transformer.word_embeddings_layernorm.bias"
+                        ).astype(dtype)},
+        "layers": layers,
+        "final_norm": {"scale": get("transformer.ln_f.weight").astype(dtype),
+                       "bias": get("transformer.ln_f.bias").astype(dtype)},
+    }, cfg, get, names, dtype)
+
+
+def _load_falcon(cfg: DecoderConfig, hf_cfg, get, names, dtype) -> Params:
+    """Falcon layout: bias-less linears (unless config "bias": true) with
+    biased LayerNorms, fused query_key_value whose packing differs by
+    generation — MQA (7B: H query heads then one k then one v),
+    new_decoder_architecture (40B: per-kv-group [g queries, k, v]
+    interleave), or NeoX-style [H, 3, dh] when multi_query=False."""
+    L, H, KV, dh, D = (cfg.num_layers, cfg.num_heads, cfg.kv_heads,
+                       cfg.head_dim, cfg.hidden_size)
+    new_arch = bool(hf_cfg.get("new_decoder_architecture", False))
+    p = "transformer.h.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+
+    def split_fused(m, trailing):
+        """Un-pack one fused qkv tensor of shape [fused_out, *trailing]
+        into (q, k, v) rows following the generation's packing."""
+        if new_arch:
+            g = H // KV
+            m = m.reshape(KV, g + 2, dh, *trailing)
+            return (m[:, :g].reshape(H * dh, *trailing),
+                    m[:, g].reshape(KV * dh, *trailing),
+                    m[:, g + 1].reshape(KV * dh, *trailing))
+        if KV == 1:
+            m = m.reshape(H + 2, dh, *trailing)
+            return (m[:H].reshape(H * dh, *trailing),
+                    m[H].reshape(dh, *trailing),
+                    m[H + 1].reshape(dh, *trailing))
+        m = m.reshape(H, 3, dh, *trailing)
+        return tuple(m[:, j].reshape(H * dh, *trailing) for j in range(3))
+
+    def split_qkv(i):
+        w = get(p.format(i) + "self_attention.query_key_value.weight"
+                ).astype(dtype)
+        return tuple(np.ascontiguousarray(m.T)
+                     for m in split_fused(w, (D,)))
+
+    qw, kw_, vw = zip(*(split_qkv(i) for i in range(L)))
+    layers = {
+        "attn": {
+            "wq": np.stack(qw), "wk": np.stack(kw_), "wv": np.stack(vw),
+            "wo": stackT(p + "self_attention.dense.weight"),
+        },
+        "mlp": {
+            "wi": stackT(p + "mlp.dense_h_to_4h.weight"),
+            "wo": stackT(p + "mlp.dense_4h_to_h.weight"),
+        },
+    }
+    if cfg.use_bias:   # falcon-rw-style "bias": true checkpoints
+        def split_qkv_b(i):
+            b = get(p.format(i) + "self_attention.query_key_value.bias"
+                    ).astype(dtype)
+            return split_fused(b, ())
+
+        qb, kb, vb = zip(*(split_qkv_b(i) for i in range(L)))
+        layers["attn"].update(
+            bq=np.stack(qb), bk=np.stack(kb), bv=np.stack(vb),
+            bo=stack(p + "self_attention.dense.bias"))
+        layers["mlp"].update(
+            bi=stack(p + "mlp.dense_h_to_4h.bias"),
+            bo=stack(p + "mlp.dense_4h_to_h.bias"))
+    if cfg.parallel_block_norms == 2:
+        layers["ln1"] = {"scale": stack(p + "ln_attn.weight"),
+                         "bias": stack(p + "ln_attn.bias")}
+        layers["ln2"] = {"scale": stack(p + "ln_mlp.weight"),
+                         "bias": stack(p + "ln_mlp.bias")}
+    else:
+        layers["ln1"] = {"scale": stack(p + "input_layernorm.weight"),
+                         "bias": stack(p + "input_layernorm.bias")}
+    return _attach_untied_head({
+        "embed": {"tokens":
+                  get("transformer.word_embeddings.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {"scale": get("transformer.ln_f.weight").astype(dtype),
+                       "bias": get("transformer.ln_f.bias").astype(dtype)},
+    }, cfg, get, names, dtype)
+
+
+def _load_phi(cfg: DecoderConfig, get, dtype) -> Params:
+    """Phi layout: parallel residual with ONE shared input layernorm,
+    separate biased q/k/v/dense projections, partial rotary, untied
+    lm_head WITH bias."""
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+    layers = {
+        "attn": {
+            "wq": stackT(p + "self_attn.q_proj.weight"),
+            "wk": stackT(p + "self_attn.k_proj.weight"),
+            "wv": stackT(p + "self_attn.v_proj.weight"),
+            "wo": stackT(p + "self_attn.dense.weight"),
+            "bq": stack(p + "self_attn.q_proj.bias"),
+            "bk": stack(p + "self_attn.k_proj.bias"),
+            "bv": stack(p + "self_attn.v_proj.bias"),
+            "bo": stack(p + "self_attn.dense.bias"),
+        },
+        "ln1": {"scale": stack(p + "input_layernorm.weight"),
+                "bias": stack(p + "input_layernorm.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.fc1.weight"), "bi": stack(p + "mlp.fc1.bias"),
+            "wo": stackT(p + "mlp.fc2.weight"), "bo": stack(p + "mlp.fc2.bias"),
+        },
+    }
+    return {
+        "embed": {"tokens": get("model.embed_tokens.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("model.final_layernorm.weight").astype(dtype),
+            "bias": get("model.final_layernorm.bias").astype(dtype)},
+        "lm_head": np.ascontiguousarray(get("lm_head.weight").astype(dtype).T),
+        "lm_head_bias": get("lm_head.bias").astype(dtype),
+    }
 
 
 def export_hf_checkpoint(cfg: DecoderConfig, params: Params,

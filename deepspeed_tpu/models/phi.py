@@ -16,8 +16,8 @@ def phi_config(size: str = "2", **overrides) -> DecoderConfig:
     }
     base = dict(vocab_size=51200, max_seq_len=2048, norm="layernorm",
                 activation="gelu", pos_emb="rope", rope_theta=10000.0,
-                use_bias=True, tie_embeddings=False, parallel_block=True,
-                parallel_block_norms=1)
+                use_bias=True, tie_embeddings=False, lm_head_bias=True,
+                parallel_block=True, parallel_block_norms=1)
     base.update(presets[size])
     base.update(overrides)
     return DecoderConfig(**base)

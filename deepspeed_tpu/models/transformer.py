@@ -44,7 +44,9 @@ class DecoderConfig:
     intermediate_size: Optional[int] = None  # None => 4*hidden (gelu) / llama default
     max_seq_len: int = 1024
     norm: str = "layernorm"                # 'layernorm' | 'rmsnorm'
-    #: 'gelu' | 'relu' | 'silu_glu' (Llama SwiGLU) | 'gelu_glu' (Gemma GeGLU)
+    #: 'gelu' (tanh approx — HF gelu_new/gelu_pytorch_tanh) | 'gelu_exact'
+    #: (erf — HF "gelu": Falcon, NeoX) | 'relu' | 'silu_glu' (Llama
+    #: SwiGLU) | 'gelu_glu' (Gemma GeGLU)
     activation: str = "gelu"
     pos_emb: str = "learned"               # 'learned' | 'rope' | 'alibi'
     rope_theta: float = 10000.0
@@ -81,6 +83,8 @@ class DecoderConfig:
     #: causal sliding-window attention (Mistral SWA): each query sees at
     #: most the last `sliding_window` keys; None = full causal
     sliding_window: Optional[int] = None
+    #: untied lm_head carries a bias vector (HF Phi's ``lm_head.bias``)
+    lm_head_bias: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -139,8 +143,10 @@ class DecoderConfig:
         if self.num_experts:
             mlp = mlp * self.num_experts + d * self.num_experts  # + router
         per_layer = attn + mlp + 2 * d
-        emb = v * d + (0 if self.pos_emb == "rope" else self.max_seq_len * d)
-        head = 0 if self.tie_embeddings else v * d
+        emb = v * d + (self.max_seq_len * d if self.pos_emb == "learned"
+                       else 0)
+        head = 0 if self.tie_embeddings else v * d + (v if self.lm_head_bias
+                                                      else 0)
         return l * per_layer + emb + head + d
 
 
@@ -338,8 +344,11 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
         hidden = jnp.einsum("btd,dh->bth", x, p["wi"])
         if "bi" in p:
             hidden = hidden + p["bi"]
-        hidden = jax.nn.relu(hidden) if cfg.activation == "relu" \
-            else jax.nn.gelu(hidden)
+        if cfg.activation == "relu":
+            hidden = jax.nn.relu(hidden)
+        else:
+            hidden = jax.nn.gelu(
+                hidden, approximate=cfg.activation != "gelu_exact")
     out = jnp.einsum("bth,hd->btd", hidden, p["wo"])
     if "bo" in p:
         out = out + p["bo"]
@@ -491,6 +500,8 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
         params["embed"]["pos"] = w(keys[9], (cfg.max_seq_len, d))
     if not cfg.tie_embeddings:
         params["lm_head"] = w(keys[10], (d, v))
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((v,), dtype)
     return params
 
 
@@ -552,6 +563,8 @@ def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
     else:
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                             preferred_element_type=jnp.float32)
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return _softcap(cfg, logits)
 
 
@@ -652,6 +665,8 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
         else:
             logits = jnp.einsum("bcd,dv->bcv", xc, w,
                                 preferred_element_type=out_dt)
+            if "lm_head_bias" in params:
+                logits = logits + params["lm_head_bias"].astype(out_dt)
         logits = _softcap(cfg, logits)
         mask = tc != ignore_index
         safe = jnp.where(mask, tc, 0)
@@ -855,4 +870,6 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
         specs["embed"]["pos"] = spec(None, fsdp)
     if not cfg.tie_embeddings:
         specs["lm_head"] = spec(fsdp, model)
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = spec(model)
     return specs

@@ -68,6 +68,18 @@ def _pack_embed(cfg: DecoderConfig, params):
     return em
 
 
+def _pack_head(params):
+    """Untied-head tree threaded through shard_map: lm_head plus Phi's
+    lm_head_bias, so the stage loss (chunked CE reads both keys) and the
+    grads reassembly see every head leaf. None when tied."""
+    if "lm_head" not in params:
+        return None
+    head = {"lm_head": params["lm_head"]}
+    if "lm_head_bias" in params:
+        head["lm_head_bias"] = params["lm_head_bias"]
+    return head
+
+
 def _apply_embed(cfg: DecoderConfig, em, tok, positions):
     """Stage-0 embed: delegates to the shared transformer.embed_tokens
     (one home for Gemma scaling / learned pos / BLOOM embed norm)."""
@@ -156,7 +168,7 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         xs = collected.reshape(M * b, t, d).astype(embed["tokens"].dtype)
         norm_params = {"final_norm": final_norm, "embed": embed}
         if head is not None:
-            norm_params["lm_head"] = head
+            norm_params.update(head)   # lm_head (+ lm_head_bias, Phi)
         xn = transformer._norm(cfg, final_norm, xs)
         loss = transformer.chunked_cross_entropy(
             cfg, norm_params, xn, labels.reshape(M * b, t),
@@ -164,7 +176,7 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         aux_all = lax.psum(aux_total, "pipe")
         return loss + aux_all
 
-    head = params.get("lm_head")
+    head = _pack_head(params)
     embed_in = _pack_embed(cfg, params)
     base_specs = (
         jax.tree.map(lambda _: P("pipe"), params["layers"]),
@@ -181,7 +193,8 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
         return fn(params["layers"], embed_in, params["final_norm"],
                   tokens, labels)
     fn = jax.shard_map(per_stage, mesh=mesh,
-                       in_specs=base_specs + (P(), P(), P()),
+                       in_specs=base_specs
+                       + (jax.tree.map(lambda _: P(), head), P(), P()),
                        out_specs=P(), axis_names={"pipe"})
     return fn(params["layers"], embed_in, params["final_norm"],
               head, tokens, labels)
@@ -258,7 +271,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
             differentiable w.r.t. the replicated tail params."""
             np_ = {"final_norm": fn_, "embed": em_}
             if has_head:
-                np_["lm_head"] = hd_
+                np_.update(hd_)   # lm_head (+ lm_head_bias, Phi)
             xn = transformer._norm(cfg, fn_, y)
             return transformer.chunked_cross_entropy(
                 cfg, np_, xn, lbl, budget_bytes=ce_budget_bytes,
@@ -377,7 +390,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
 
     layer_specs = jax.tree.map(lambda _: P("pipe"), params["layers"])
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
-    head = params.get("lm_head")
+    head = _pack_head(params)
     embed_in = _pack_embed(cfg, params)
     in_specs = (layer_specs, rep(embed_in),
                 rep(params["final_norm"]))
@@ -395,15 +408,15 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                  "final_norm": g_norm}
     else:
         out = jax.shard_map(
-            per_stage, mesh=mesh, in_specs=in_specs + (P(), P(), P()),
+            per_stage, mesh=mesh, in_specs=in_specs + (rep(head), P(), P()),
             out_specs=(P(), layer_specs, rep(embed_in),
-                       rep(params["final_norm"]), P()),
+                       rep(params["final_norm"]), rep(head)),
             axis_names={"pipe"})(params["layers"], embed_in,
                                  params["final_norm"], head, tokens,
                                  labels)
         loss, g_layers, g_embed, g_norm, g_head = out
         grads = {"layers": g_layers, "embed": g_embed,
-                 "final_norm": g_norm, "lm_head": g_head}
+                 "final_norm": g_norm, **g_head}
     if cfg.embed_norm:
         grads["embed_norm"] = grads["embed"].pop("_embed_norm")
     grads = {k: grads[k] for k in params}     # preserve key order

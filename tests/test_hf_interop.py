@@ -261,3 +261,154 @@ def test_qwen2_export_roundtrip(tmp_path):
     with torch.no_grad():
         theirs = reloaded(torch.tensor(tokens.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# classic-architecture families: GPT-2, OPT, BLOOM, Falcon, Phi
+# (reference: module_inject/containers/{gpt2,opt,bloom,...}.py policies +
+# inference/v2/model_implementations/{opt,falcon,phi}/)
+# ---------------------------------------------------------------------------
+
+def _parity(hf_model, model_dir, n_tok=16, rtol=2e-4, atol=2e-4):
+    cfg, params = load_hf_checkpoint(model_dir)
+    tokens = np.arange(1, n_tok + 1, dtype=np.int32)[None].repeat(2, 0)
+    ours = np.asarray(transformer.forward(
+        cfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=rtol, atol=atol)
+    return cfg
+
+
+def test_gpt2_logits_parity(tmp_path):
+    """GPT-2: Conv1D [in,out] weights, column-fused c_attn, learned
+    positions, tied head."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config(n_embd=64, n_layer=2, n_head=4, vocab_size=256,
+                     n_positions=128)
+    torch.manual_seed(2)
+    model = GPT2LMHeadModel(cfg).eval()
+    d = str(tmp_path / "hf_gpt2")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.pos_emb == "learned" and got.tie_embeddings
+
+
+def test_opt_logits_parity(tmp_path):
+    """OPT: separate biased projections, ReLU MLP, +2-offset learned
+    positions, per-layer final_layer_norm as ln2."""
+    from transformers import OPTConfig, OPTForCausalLM
+    cfg = OPTConfig(hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+                    num_attention_heads=4, vocab_size=256,
+                    max_position_embeddings=128, word_embed_proj_dim=64)
+    torch.manual_seed(3)
+    model = OPTForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_opt")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.activation == "relu"
+
+
+def test_bloom_logits_parity(tmp_path):
+    """BLOOM: head-interleaved fused qkv, ALiBi, word-embeddings
+    LayerNorm — the gold check for the alibi_slopes convention."""
+    from transformers import BloomConfig, BloomForCausalLM
+    cfg = BloomConfig(hidden_size=64, n_layer=2, n_head=4, vocab_size=512)
+    torch.manual_seed(4)
+    model = BloomForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_bloom")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.pos_emb == "alibi" and got.embed_norm
+
+
+def test_falcon_mqa_logits_parity(tmp_path):
+    """Falcon-7B generation: MQA fused qkv ([H queries, k, v]), ONE shared
+    input layernorm feeding both parallel branches, bias-less linears."""
+    from transformers import FalconConfig, FalconForCausalLM
+    cfg = FalconConfig(hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, vocab_size=256,
+                       multi_query=True, new_decoder_architecture=False,
+                       parallel_attn=True, bias=False, alibi=False,
+                       max_position_embeddings=128)
+    torch.manual_seed(5)
+    model = FalconForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_falcon7b")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.kv_heads == 1 and got.parallel_block
+    assert got.parallel_block_norms == 1
+
+
+def test_falcon_new_arch_logits_parity(tmp_path):
+    """Falcon-40B generation: new_decoder_architecture per-kv-group qkv
+    interleave, separate ln_attn/ln_mlp."""
+    from transformers import FalconConfig, FalconForCausalLM
+    cfg = FalconConfig(hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_kv_heads=2,
+                       vocab_size=256, new_decoder_architecture=True,
+                       parallel_attn=True, bias=False, alibi=False,
+                       max_position_embeddings=128)
+    torch.manual_seed(6)
+    model = FalconForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_falcon40b")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.kv_heads == 2 and got.parallel_block_norms == 2
+
+
+def test_phi_logits_parity(tmp_path):
+    """Phi-2: parallel residual w/ one shared norm, partial rotary
+    (rotary_pct 0.5), untied lm_head WITH bias."""
+    from transformers import PhiConfig, PhiForCausalLM
+    cfg = PhiConfig(hidden_size=64, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    vocab_size=256, max_position_embeddings=128,
+                    partial_rotary_factor=0.5)
+    torch.manual_seed(7)
+    model = PhiForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_phi")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.lm_head_bias and got.rotary_pct == 0.5
+
+
+def test_phi_cached_decode_matches_forward(tmp_path):
+    """lm_head bias must flow through the KV-cached decode path too."""
+    from transformers import PhiConfig, PhiForCausalLM
+    cfg = PhiConfig(hidden_size=64, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    vocab_size=256, max_position_embeddings=128,
+                    partial_rotary_factor=0.5)
+    torch.manual_seed(8)
+    PhiForCausalLM(cfg).eval().save_pretrained(
+        str(tmp_path / "hf_phi2"), safe_serialization=True)
+    dcfg, params = load_hf_checkpoint(str(tmp_path / "hf_phi2"))
+    params = jax.tree.map(jnp.asarray, params)
+    tokens = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    full = transformer.forward(dcfg, params, tokens)
+
+    cache = transformer.init_kv_cache(dcfg, 1, 16)
+    logits = None
+    for t in range(tokens.shape[1]):
+        logits, cache = transformer.forward_with_cache(
+            dcfg, params, tokens[:, t:t + 1], cache, t)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, -1]), rtol=1e-3, atol=1e-3)
+
+
+def test_falcon_biased_logits_parity(tmp_path):
+    """Falcon with config bias=true (falcon-rw lineage): fused qkv biases
+    must be un-packed with the same per-variant layout as the weights."""
+    from transformers import FalconConfig, FalconForCausalLM
+    cfg = FalconConfig(hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_kv_heads=2,
+                       vocab_size=256, new_decoder_architecture=True,
+                       parallel_attn=True, bias=True, alibi=False,
+                       max_position_embeddings=128)
+    torch.manual_seed(9)
+    model = FalconForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_falcon_bias")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.use_bias

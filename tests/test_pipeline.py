@@ -231,3 +231,47 @@ def test_pipeline_sp_rejected(devices):
     cfg["sequence_parallel"] = {"size": 2}
     with pytest.raises(ValueError, match="does not compose"):
         initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
+
+
+def test_1f1b_phi_untied_head_bias_grads(devices):
+    """Phi-style untied lm_head WITH bias must flow through both pipeline
+    schedules: the packed head tree carries lm_head_bias, the loss includes
+    it, and its grads come back under the right keys (regression: the head
+    used to be threaded as a bare array, dropping the bias and KeyError-ing
+    the 1F1B grads reassembly)."""
+    import jax.tree_util as jtu
+    from deepspeed_tpu.models.phi import phi_config
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.runtime.pipe.pipeline import (
+        pipelined_loss, pipelined_loss_and_grads_1f1b)
+    build_mesh(pipe=2, data=4)
+    model = phi_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    assert model.lm_head_bias and not model.tie_embeddings
+    p = init_params(model, jax.random.PRNGKey(0))
+    # nonzero bias so a dropped bias changes the loss
+    p["lm_head_bias"] = jax.random.normal(
+        jax.random.PRNGKey(1), p["lm_head_bias"].shape, jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (4, 2, SEQ), dtype=np.int32))
+    labels = jnp.asarray(rng.integers(0, VOCAB, (4, 2, SEQ), dtype=np.int32))
+
+    # GPipe loss must equal the non-pipeline forward loss (bias included)
+    from deepspeed_tpu.models import transformer as T
+    flat_tok = tokens.reshape(8, SEQ)
+    flat_lbl = labels.reshape(8, SEQ)
+    hidden, _ = T.forward_hidden(model, p, flat_tok)
+    ref = float(T.chunked_cross_entropy(model, p, hidden, flat_lbl))
+    gl, gg = jax.jit(lambda q: jax.value_and_grad(
+        lambda r: pipelined_loss(model, r, tokens, labels))(q))(p)
+    np.testing.assert_allclose(float(gl), ref, rtol=1e-5)
+    assert "lm_head_bias" in gg and np.abs(np.asarray(
+        gg["lm_head_bias"])).max() > 0
+
+    l1, g1 = jax.jit(lambda q: pipelined_loss_and_grads_1f1b(
+        model, q, tokens, labels))(p)
+    np.testing.assert_allclose(float(gl), float(l1), rtol=1e-5)
+    assert jtu.tree_structure(gg) == jtu.tree_structure(g1)
+    for (path, a), (_, b) in zip(jtu.tree_flatten_with_path(gg)[0],
+                                 jtu.tree_flatten_with_path(g1)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4, err_msg=str(path))
