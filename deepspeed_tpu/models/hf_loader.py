@@ -134,7 +134,8 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             intermediate_size=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
-            norm="layernorm", activation="gelu_exact",
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation", "gelu")),
             pos_emb="alibi" if hf.get("alibi") else "rope",
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
@@ -207,13 +208,23 @@ def _is_gemma_layout(cfg: DecoderConfig) -> bool:
 def _is_neox_layout(cfg: DecoderConfig) -> bool:
     """NeoX/Pythia family marker (covers use_parallel_residual False too:
     sequential NeoX still has the layernorm+bias+gelu+rope layout that the
-    llama mapping can't express)."""
+    llama mapping can't express). GQA is excluded — NeoX has no kv-head
+    grouping, so a biased GQA falcon must NOT route here (its kv rows
+    cannot be re-interleaved into the [H, 3, dh] fused layout)."""
     return (cfg.norm == "layernorm" and cfg.pos_emb == "rope"
             and cfg.use_bias and cfg.activation in ("gelu", "gelu_exact")
-            and cfg.has_ln2)   # 1-norm parallel models (phi) are NOT neox
+            and cfg.has_ln2   # 1-norm parallel models (phi) are NOT neox
+            and cfg.kv_heads == cfg.num_heads)
 
 
 def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
+    def act_name(exact_name="gelu", tanh_name="gelu_new"):
+        """HF 'gelu' is exact erf; tanh-approx models must export the
+        tanh spelling or transformers reloads with the wrong act."""
+        if cfg.activation == "relu":
+            return "relu"
+        return exact_name if cfg.activation == "gelu_exact" else tanh_name
+
     if _is_neox_layout(cfg):
         return {
             "model_type": "gpt_neox",
@@ -229,19 +240,89 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             "layer_norm_eps": cfg.norm_eps,
             "use_parallel_residual": cfg.parallel_block,
             "tie_word_embeddings": cfg.tie_embeddings,
-            # HF "gelu" is the exact erf form; tanh-approx models must
-            # export gelu_new or transformers reloads with the wrong act
-            "hidden_act": ("gelu" if cfg.activation == "gelu_exact"
-                           else "gelu_new"),
+            "hidden_act": act_name(),
             "torch_dtype": "float32",
         }
+    base = {
+        "vocab_size": cfg.vocab_size,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": "float32",
+    }
+    if cfg.norm == "layernorm" and cfg.pos_emb == "learned":
+        if cfg.activation == "relu":   # OPT lineage
+            return {**base, "model_type": "opt",
+                    "architectures": ["OPTForCausalLM"],
+                    "hidden_size": cfg.hidden_size,
+                    "num_hidden_layers": cfg.num_layers,
+                    "num_attention_heads": cfg.num_heads,
+                    "ffn_dim": cfg.ffn_size,
+                    "max_position_embeddings": cfg.max_seq_len,
+                    "word_embed_proj_dim": cfg.hidden_size,
+                    "do_layer_norm_before": True, "enable_bias": True,
+                    "activation_function": "relu"}
+        return {**base, "model_type": "gpt2",
+                "architectures": ["GPT2LMHeadModel"],
+                "n_embd": cfg.hidden_size, "n_layer": cfg.num_layers,
+                "n_head": cfg.num_heads, "n_positions": cfg.max_seq_len,
+                "n_ctx": cfg.max_seq_len, "n_inner": cfg.ffn_size,
+                "layer_norm_epsilon": cfg.norm_eps,
+                "activation_function": act_name()}
+    if cfg.pos_emb == "alibi" and cfg.embed_norm:   # BLOOM
+        return {**base, "model_type": "bloom",
+                "architectures": ["BloomForCausalLM"],
+                "hidden_size": cfg.hidden_size, "n_layer": cfg.num_layers,
+                "n_head": cfg.num_heads,
+                "layer_norm_epsilon": cfg.norm_eps, "seq_length":
+                cfg.max_seq_len}
+    if (cfg.parallel_block and cfg.norm == "layernorm"
+            and not cfg.lm_head_bias
+            and (not cfg.use_bias or cfg.has_ln2)):
+        # Falcon: pick the fused-qkv generation that can express the
+        # head layout — old MQA only fits kv=1 + one shared norm. Biased
+        # ONE-norm parallel models fall through to the phi branch below
+        # (separate biased projections — the same math, an expressible
+        # layout); biased 2-norm GQA exports as falcon "bias": true.
+        new_arch = cfg.kv_heads > 1 or cfg.parallel_block_norms == 2
+        hf = {**base, "model_type": "falcon",
+              "architectures": ["FalconForCausalLM"],
+              "hidden_size": cfg.hidden_size,
+              "num_hidden_layers": cfg.num_layers,
+              "num_attention_heads": cfg.num_heads,
+              "ffn_hidden_size": cfg.ffn_size,
+              "max_position_embeddings": cfg.max_seq_len,
+              "layer_norm_epsilon": cfg.norm_eps,
+              "rope_theta": cfg.rope_theta,
+              "alibi": cfg.pos_emb == "alibi", "bias": cfg.use_bias,
+              "activation": act_name("gelu", "gelu_pytorch_tanh"),
+              "parallel_attn": True,
+              "new_decoder_architecture": new_arch,
+              "multi_query": cfg.kv_heads == 1}
+        if new_arch:
+            hf["num_kv_heads"] = cfg.kv_heads
+            hf["num_ln_in_parallel_attn"] = cfg.parallel_block_norms
+        return hf
+    if (cfg.parallel_block and not cfg.has_ln2 and cfg.use_bias
+            and cfg.pos_emb == "rope"):   # Phi
+        return {**base, "model_type": "phi",
+                "architectures": ["PhiForCausalLM"],
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "num_key_value_heads": cfg.kv_heads,
+                "intermediate_size": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "partial_rotary_factor": cfg.rotary_pct,
+                "layer_norm_eps": cfg.norm_eps,
+                "rope_theta": cfg.rope_theta,
+                "hidden_act": act_name(),
+                "qk_layernorm": False}
     if not (cfg.norm == "rmsnorm" and cfg.pos_emb == "rope"
             and cfg.is_glu):
         raise ValueError(
             f"config_to_hf: no HF layout for norm={cfg.norm} "
             f"pos_emb={cfg.pos_emb} activation={cfg.activation}; "
-            f"supported exports: llama/mistral/mixtral/qwen2-like, "
-            f"gemma, gpt_neox")
+            f"supported exports: llama/mistral/mixtral/qwen2-like, gemma, "
+            f"gpt_neox, gpt2, opt, bloom, falcon, phi")
     if _is_gemma_layout(cfg):
         mt = "gemma"
         arch = ["GemmaForCausalLM"]
@@ -758,15 +839,11 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     (single shard) + config.json — the reverse mapping, so models trained
     here load in transformers."""
     import jax
-    from safetensors.numpy import save_file
     if _is_neox_layout(cfg):
         return _export_neox(cfg, params, out_dir)
-    if cfg.parallel_block:
-        raise NotImplementedError(
-            "export_hf_checkpoint supports llama-family, gemma and "
-            "GPT-NeoX layouts; other parallel-residual variants (falcon) "
-            "need their own key mapping — not implemented yet")
     cfg_hf = config_to_hf(cfg)   # raises on unsupported layouts
+    if cfg_hf["model_type"] in ("gpt2", "opt", "bloom", "falcon", "phi"):
+        return _export_classic(cfg, cfg_hf, params, out_dir)
 
     os.makedirs(out_dir, exist_ok=True)
     host = jax.tree.map(
@@ -823,17 +900,191 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
                 np.ascontiguousarray(m["wi"][i].T)
             out[p.format(i) + "mlp.down_proj.weight"] = \
                 np.ascontiguousarray(m["wo"][i].T)
+    _save_hf(out, cfg_hf, out_dir)
+
+
+def _save_hf(out: Dict[str, np.ndarray], cfg_hf: Dict[str, Any],
+             out_dir: str) -> None:
+    """Shared export epilogue: safetensors + config.json."""
+    from safetensors.numpy import save_file
+    os.makedirs(out_dir, exist_ok=True)
     save_file(out, os.path.join(out_dir, "model.safetensors"),
               metadata={"format": "pt"})
     with open(os.path.join(out_dir, "config.json"), "w") as fh:
         json.dump(cfg_hf, fh, indent=2)
 
 
+def _fuse_interleaved(a: Params, i: int, H: int, dh: int, D: int):
+    """Re-pack separate q/k/v (+biases) into the NeoX/BLOOM head-
+    interleaved fused layout: [H, 3, dh] on the out dim."""
+    fused_w = np.stack(
+        [a[k][i].T.reshape(H, dh, D) for k in ("wq", "wk", "wv")],
+        axis=1).reshape(3 * H * dh, D)
+    fused_b = np.stack(
+        [a[k][i].reshape(H, dh) for k in ("bq", "bk", "bv")],
+        axis=1).reshape(-1)
+    return np.ascontiguousarray(fused_w), fused_b
+
+
+def _export_classic(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
+                    params: Params, out_dir: str) -> None:
+    """Reverse mappings for the classic families (GPT-2/OPT/BLOOM/Falcon/
+    Phi) — each the inverse of its ``_load_*`` including the fused-qkv
+    re-pack and OPT's +2 position rows."""
+    import jax
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x), np.float32), params)
+    mt = cfg_hf["model_type"]
+    L, H, KV, dh, D = (cfg.num_layers, cfg.num_heads, cfg.kv_heads,
+                       cfg.head_dim, cfg.hidden_size)
+    lyr = host["layers"]
+    a, m = lyr["attn"], lyr["mlp"]
+    out: Dict[str, np.ndarray] = {}
+    C = np.ascontiguousarray
+
+    def put_ln(dst, src, i):
+        out[dst + ".weight"] = src["scale"][i]
+        out[dst + ".bias"] = src["bias"][i]
+
+    if mt == "gpt2":
+        out["transformer.wte.weight"] = host["embed"]["tokens"]
+        out["transformer.wpe.weight"] = host["embed"]["pos"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            out[p + "attn.c_attn.weight"] = C(np.concatenate(
+                [a["wq"][i], a["wk"][i], a["wv"][i]], axis=1))
+            out[p + "attn.c_attn.bias"] = np.concatenate(
+                [a["bq"][i], a["bk"][i], a["bv"][i]])
+            out[p + "attn.c_proj.weight"] = a["wo"][i]
+            out[p + "attn.c_proj.bias"] = a["bo"][i]
+            out[p + "mlp.c_fc.weight"] = m["wi"][i]
+            out[p + "mlp.c_fc.bias"] = m["bi"][i]
+            out[p + "mlp.c_proj.weight"] = m["wo"][i]
+            out[p + "mlp.c_proj.bias"] = m["bo"][i]
+            put_ln(p + "ln_1", lyr["ln1"], i)
+            put_ln(p + "ln_2", lyr["ln2"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "opt":
+        out["model.decoder.embed_tokens.weight"] = host["embed"]["tokens"]
+        # rows 0/1 are the padding-position slots HF indexes below the
+        # +2 offset; they are never read for dense (full-mask) sequences
+        out["model.decoder.embed_positions.weight"] = np.concatenate(
+            [np.zeros((2, D), np.float32), host["embed"]["pos"]])
+        out["model.decoder.final_layer_norm.weight"] = \
+            host["final_norm"]["scale"]
+        out["model.decoder.final_layer_norm.bias"] = \
+            host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"model.decoder.layers.{i}."
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                                 ("v", "v_proj"), ("o", "out_proj")):
+                key = "wo" if ours == "o" else "w" + ours
+                bkey = "bo" if ours == "o" else "b" + ours
+                out[p + f"self_attn.{theirs}.weight"] = C(a[key][i].T)
+                out[p + f"self_attn.{theirs}.bias"] = a[bkey][i]
+            out[p + "fc1.weight"] = C(m["wi"][i].T)
+            out[p + "fc1.bias"] = m["bi"][i]
+            out[p + "fc2.weight"] = C(m["wo"][i].T)
+            out[p + "fc2.bias"] = m["bo"][i]
+            put_ln(p + "self_attn_layer_norm", lyr["ln1"], i)
+            put_ln(p + "final_layer_norm", lyr["ln2"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "bloom":
+        out["transformer.word_embeddings.weight"] = host["embed"]["tokens"]
+        out["transformer.word_embeddings_layernorm.weight"] = \
+            host["embed_norm"]["scale"]
+        out["transformer.word_embeddings_layernorm.bias"] = \
+            host["embed_norm"]["bias"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            fused_w, fused_b = _fuse_interleaved(a, i, H, dh, D)
+            out[p + "self_attention.query_key_value.weight"] = fused_w
+            out[p + "self_attention.query_key_value.bias"] = fused_b
+            out[p + "self_attention.dense.weight"] = C(a["wo"][i].T)
+            out[p + "self_attention.dense.bias"] = a["bo"][i]
+            out[p + "mlp.dense_h_to_4h.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.dense_h_to_4h.bias"] = m["bi"][i]
+            out[p + "mlp.dense_4h_to_h.weight"] = C(m["wo"][i].T)
+            out[p + "mlp.dense_4h_to_h.bias"] = m["bo"][i]
+            put_ln(p + "input_layernorm", lyr["ln1"], i)
+            put_ln(p + "post_attention_layernorm", lyr["ln2"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "falcon":
+        new_arch = cfg_hf["new_decoder_architecture"]
+        out["transformer.word_embeddings.weight"] = host["embed"]["tokens"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            q = a["wq"][i].T.reshape(H, dh, D)
+            k = a["wk"][i].T.reshape(KV, dh, D)
+            v = a["wv"][i].T.reshape(KV, dh, D)
+            if new_arch:
+                g = H // KV
+                fused = np.concatenate(
+                    [q.reshape(KV, g, dh, D), k[:, None], v[:, None]],
+                    axis=1).reshape(KV * (g + 2) * dh, D)
+            else:   # old MQA: H query heads then k then v
+                fused = np.concatenate([q, k, v]).reshape((H + 2) * dh, D)
+            out[p + "self_attention.query_key_value.weight"] = C(fused)
+            out[p + "self_attention.dense.weight"] = C(a["wo"][i].T)
+            out[p + "mlp.dense_h_to_4h.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.dense_4h_to_h.weight"] = C(m["wo"][i].T)
+            if cfg.use_bias:   # "bias": true — inverse of split_fused
+                qb = a["bq"][i].reshape(H, dh)
+                kb = a["bk"][i].reshape(KV, dh)
+                vb = a["bv"][i].reshape(KV, dh)
+                if new_arch:
+                    fb = np.concatenate(
+                        [qb.reshape(KV, H // KV, dh), kb[:, None],
+                         vb[:, None]], axis=1).reshape(-1)
+                else:
+                    fb = np.concatenate([qb, kb, vb]).reshape(-1)
+                out[p + "self_attention.query_key_value.bias"] = fb
+                out[p + "self_attention.dense.bias"] = a["bo"][i]
+                out[p + "mlp.dense_h_to_4h.bias"] = m["bi"][i]
+                out[p + "mlp.dense_4h_to_h.bias"] = m["bo"][i]
+            if cfg.parallel_block_norms == 2:
+                put_ln(p + "ln_attn", lyr["ln1"], i)
+                put_ln(p + "ln_mlp", lyr["ln2"], i)
+            else:
+                put_ln(p + "input_layernorm", lyr["ln1"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    else:   # phi
+        out["model.embed_tokens.weight"] = host["embed"]["tokens"]
+        out["model.final_layernorm.weight"] = host["final_norm"]["scale"]
+        out["model.final_layernorm.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"model.layers.{i}."
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                                 ("v", "v_proj"), ("o", "dense")):
+                key = "wo" if ours == "o" else "w" + ours
+                bkey = "bo" if ours == "o" else "b" + ours
+                out[p + f"self_attn.{theirs}.weight"] = C(a[key][i].T)
+                out[p + f"self_attn.{theirs}.bias"] = a[bkey][i]
+            out[p + "mlp.fc1.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.fc1.bias"] = m["bi"][i]
+            out[p + "mlp.fc2.weight"] = C(m["wo"][i].T)
+            out[p + "mlp.fc2.bias"] = m["bo"][i]
+            put_ln(p + "input_layernorm", lyr["ln1"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+            out["lm_head.bias"] = host.get(
+                "lm_head_bias", np.zeros(cfg.vocab_size, np.float32))
+    _save_hf(out, cfg_hf, out_dir)
+
+
 def _export_neox(cfg: DecoderConfig, params: Params, out_dir: str) -> None:
     """Reverse of _load_neox (re-interleaves the fused qkv)."""
     import jax
-    from safetensors.numpy import save_file
-    os.makedirs(out_dir, exist_ok=True)
     host = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x), np.float32), params)
     H, dh, D = cfg.num_heads, cfg.head_dim, cfg.hidden_size
@@ -873,7 +1124,4 @@ def _export_neox(cfg: DecoderConfig, params: Params, out_dir: str) -> None:
         out[pi + "mlp.dense_4h_to_h.weight"] = \
             np.ascontiguousarray(m["wo"][i].T)
         out[pi + "mlp.dense_4h_to_h.bias"] = m["bo"][i]
-    save_file(out, os.path.join(out_dir, "model.safetensors"),
-              metadata={"format": "pt"})
-    with open(os.path.join(out_dir, "config.json"), "w") as fh:
-        json.dump(config_to_hf(cfg), fh, indent=2)
+    _save_hf(out, config_to_hf(cfg), out_dir)

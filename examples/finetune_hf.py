@@ -4,9 +4,8 @@ export back to HF format.
     python examples/finetune_hf.py --model-dir /path/to/hf_llama \
         --steps 10 --export-dir /tmp/finetuned_hf
 
-Loading works for all 11 in-tree families (Llama/Mistral/Mixtral/Qwen2/
-GPT-NeoX/Gemma/GPT-2/OPT/BLOOM/Falcon/Phi); --export-dir re-export covers
-Llama/Mistral/Mixtral/Qwen2/GPT-NeoX/Gemma layouts
+Load + --export-dir re-export work for all 11 in-tree families (Llama/
+Mistral/Mixtral/Qwen2/GPT-NeoX/Gemma/GPT-2/OPT/BLOOM/Falcon/Phi)
 (models/hf_loader.py maps names both directions; logits parity is tested
 in tests/test_hf_interop.py).
 """

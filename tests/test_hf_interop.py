@@ -234,8 +234,12 @@ def test_gemma_export_roundtrip(tmp_path):
 
 
 def test_export_rejects_unsupported_layout(tmp_path):
-    from deepspeed_tpu.models.gpt import gpt2_config
-    cfg = gpt2_config("tiny")
+    """A layout no HF family can express (RMSNorm + learned positions)
+    must raise, not write a silently-wrong checkpoint."""
+    cfg = transformer.DecoderConfig(
+        hidden_size=64, num_layers=2, num_heads=4, vocab_size=256,
+        max_seq_len=64, norm="rmsnorm", pos_emb="learned",
+        activation="gelu", use_bias=False)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises((ValueError, NotImplementedError)):
         export_hf_checkpoint(cfg, params, str(tmp_path / "nope"))
@@ -412,3 +416,69 @@ def test_falcon_biased_logits_parity(tmp_path):
     model.save_pretrained(d, safe_serialization=True)
     got = _parity(model, d)
     assert got.use_bias
+
+
+@pytest.mark.parametrize("family", ["gpt2", "opt", "bloom", "falcon_mqa",
+                                    "falcon_new", "falcon_bias2", "phi"])
+def test_classic_export_roundtrip(family, tmp_path):
+    """Export a random classic-family model, reload via transformers, match
+    logits — the reverse mapping incl. fused-qkv re-pack and OPT's +2
+    position rows."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.models.opt import opt_config
+    from deepspeed_tpu.models.bloom import bloom_config
+    from deepspeed_tpu.models.falcon import falcon_config
+    from deepspeed_tpu.models.phi import phi_config
+    make = {
+        "gpt2": lambda: gpt2_config("tiny"),
+        "opt": lambda: opt_config("tiny"),
+        "bloom": lambda: bloom_config("tiny"),
+        "falcon_mqa": lambda: falcon_config("tiny"),
+        "falcon_new": lambda: falcon_config("tiny", num_kv_heads=2,
+                                            parallel_block_norms=2),
+        # biased 2-norm GQA falcon ("bias": true lineage) must export as
+        # falcon with the fused qkv bias re-packed per kv group
+        "falcon_bias2": lambda: falcon_config("tiny", num_kv_heads=2,
+                                              parallel_block_norms=2,
+                                              use_bias=True),
+        "phi": lambda: phi_config("tiny"),
+    }[family]
+    cfg = make()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(11))
+    if cfg.lm_head_bias:
+        params["lm_head_bias"] = jax.random.normal(
+            jax.random.PRNGKey(12), (cfg.vocab_size,), jnp.float32) * 0.1
+    out = str(tmp_path / f"export_{family}")
+    export_hf_checkpoint(cfg, params, out)
+    with open(os.path.join(out, "config.json")) as fh:
+        mt = json.load(fh)["model_type"]
+    from transformers import AutoModelForCausalLM
+    hf = AutoModelForCausalLM.from_pretrained(out).eval()
+    tokens = np.arange(3, 17, dtype=np.int32)[None]
+    ours = np.asarray(transformer.forward(cfg, params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3,
+                               err_msg=f"{family} exported as {mt}")
+
+
+def test_falcon_bias_one_norm_exports_as_phi(tmp_path):
+    """A biased ONE-norm parallel model (falcon 'bias': true, 7B-style
+    shared norm) has no falcon fused layout that keeps phi-style separate
+    biases distinguishable — it exports as the mathematically-equivalent
+    phi layout (separate biased projections, full rotary)."""
+    from deepspeed_tpu.models.falcon import falcon_config
+    cfg = falcon_config("tiny", use_bias=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(13))
+    out = str(tmp_path / "export_falcon_bias1")
+    export_hf_checkpoint(cfg, params, out)
+    with open(os.path.join(out, "config.json")) as fh:
+        hf_cfg = json.load(fh)
+    assert hf_cfg["model_type"] == "phi"
+    from transformers import AutoModelForCausalLM
+    hf = AutoModelForCausalLM.from_pretrained(out).eval()
+    tokens = np.arange(3, 15, dtype=np.int32)[None]
+    ours = np.asarray(transformer.forward(cfg, params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
