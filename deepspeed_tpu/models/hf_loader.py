@@ -205,6 +205,15 @@ def _is_gemma_layout(cfg: DecoderConfig) -> bool:
     return cfg.activation == "gelu_glu" and cfg.scale_embeddings
 
 
+def _no_exotics(cfg: DecoderConfig) -> bool:
+    """Features NO classic (gpt2/opt/bloom/falcon/phi/neox) HF layout has
+    a slot for — a config carrying any of them must NOT match those
+    branches, or the export silently drops the feature."""
+    return (not cfg.num_experts and cfg.head_dim_override is None
+            and not cfg.scale_embeddings and not cfg.logit_softcap
+            and cfg.sliding_window is None and not cfg.is_glu)
+
+
 def _is_neox_layout(cfg: DecoderConfig) -> bool:
     """NeoX/Pythia family marker (covers use_parallel_residual False too:
     sequential NeoX still has the layernorm+bias+gelu+rope layout that the
@@ -214,7 +223,9 @@ def _is_neox_layout(cfg: DecoderConfig) -> bool:
     return (cfg.norm == "layernorm" and cfg.pos_emb == "rope"
             and cfg.use_bias and cfg.activation in ("gelu", "gelu_exact")
             and cfg.has_ln2   # 1-norm parallel models (phi) are NOT neox
-            and cfg.kv_heads == cfg.num_heads)
+            and cfg.kv_heads == cfg.num_heads
+            and _no_exotics(cfg) and not cfg.embed_norm
+            and not cfg.lm_head_bias)
 
 
 def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
@@ -248,7 +259,11 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "torch_dtype": "float32",
     }
-    if cfg.norm == "layernorm" and cfg.pos_emb == "learned":
+    untied_bias = cfg.lm_head_bias and not cfg.tie_embeddings
+    if (cfg.norm == "layernorm" and cfg.pos_emb == "learned"
+            and cfg.use_bias and not cfg.parallel_block
+            and _no_exotics(cfg) and not cfg.embed_norm
+            and not untied_bias):   # no lm_head.bias slot in gpt2/opt
         if cfg.activation == "relu":   # OPT lineage
             return {**base, "model_type": "opt",
                     "architectures": ["OPTForCausalLM"],
@@ -267,7 +282,9 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
                 "n_ctx": cfg.max_seq_len, "n_inner": cfg.ffn_size,
                 "layer_norm_epsilon": cfg.norm_eps,
                 "activation_function": act_name()}
-    if cfg.pos_emb == "alibi" and cfg.embed_norm:   # BLOOM
+    if (cfg.pos_emb == "alibi" and cfg.embed_norm and cfg.use_bias
+            and cfg.norm == "layernorm" and not cfg.parallel_block
+            and _no_exotics(cfg) and not untied_bias):   # BLOOM
         return {**base, "model_type": "bloom",
                 "architectures": ["BloomForCausalLM"],
                 "hidden_size": cfg.hidden_size, "n_layer": cfg.num_layers,
@@ -275,7 +292,8 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
                 "layer_norm_epsilon": cfg.norm_eps, "seq_length":
                 cfg.max_seq_len}
     if (cfg.parallel_block and cfg.norm == "layernorm"
-            and not cfg.lm_head_bias
+            and not cfg.lm_head_bias and _no_exotics(cfg)
+            and not cfg.embed_norm and cfg.rotary_pct == 1.0
             and (not cfg.use_bias or cfg.has_ln2)):
         # Falcon: pick the fused-qkv generation that can express the
         # head layout — old MQA only fits kv=1 + one shared norm. Biased
@@ -302,7 +320,8 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             hf["num_ln_in_parallel_attn"] = cfg.parallel_block_norms
         return hf
     if (cfg.parallel_block and not cfg.has_ln2 and cfg.use_bias
-            and cfg.pos_emb == "rope"):   # Phi
+            and cfg.pos_emb == "rope" and _no_exotics(cfg)
+            and not cfg.embed_norm):   # Phi
         return {**base, "model_type": "phi",
                 "architectures": ["PhiForCausalLM"],
                 "hidden_size": cfg.hidden_size,
@@ -317,12 +336,18 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
                 "hidden_act": act_name(),
                 "qk_layernorm": False}
     if not (cfg.norm == "rmsnorm" and cfg.pos_emb == "rope"
-            and cfg.is_glu):
+            and cfg.is_glu and not cfg.parallel_block
+            and not cfg.embed_norm and not untied_bias
+            and cfg.rotary_pct == 1.0):
+        # the llama-family layouts are sequential-residual, full-rotary,
+        # bias-less-head — a config outside every branch must RAISE, not
+        # write a silently-wrong checkpoint
         raise ValueError(
             f"config_to_hf: no HF layout for norm={cfg.norm} "
-            f"pos_emb={cfg.pos_emb} activation={cfg.activation}; "
-            f"supported exports: llama/mistral/mixtral/qwen2-like, gemma, "
-            f"gpt_neox, gpt2, opt, bloom, falcon, phi")
+            f"pos_emb={cfg.pos_emb} activation={cfg.activation} "
+            f"parallel_block={cfg.parallel_block}; supported exports: "
+            f"llama/mistral/mixtral/qwen2-like, gemma, gpt_neox, gpt2, "
+            f"opt, bloom, falcon, phi")
     if _is_gemma_layout(cfg):
         mt = "gemma"
         arch = ["GemmaForCausalLM"]
@@ -1099,16 +1124,9 @@ def _export_neox(cfg: DecoderConfig, params: Params, out_dir: str) -> None:
     p = "gpt_neox.layers.{}."
     for i in range(cfg.num_layers):
         a = lyr["attn"]
-        # [D, H*dh] per proj → fused [H, 3, dh, D] row-major out
-        fused_w = np.stack(
-            [a[k][i].T.reshape(H, dh, D) for k in ("wq", "wk", "wv")],
-            axis=1).reshape(3 * H * dh, D)
-        fused_b = np.stack(
-            [a[k][i].reshape(H, dh) for k in ("bq", "bk", "bv")],
-            axis=1).reshape(-1)
+        fused_w, fused_b = _fuse_interleaved(a, i, H, dh, D)
         pi = p.format(i)
-        out[pi + "attention.query_key_value.weight"] = \
-            np.ascontiguousarray(fused_w)
+        out[pi + "attention.query_key_value.weight"] = fused_w
         out[pi + "attention.query_key_value.bias"] = fused_b
         out[pi + "attention.dense.weight"] = \
             np.ascontiguousarray(a["wo"][i].T)

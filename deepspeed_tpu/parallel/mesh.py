@@ -41,6 +41,52 @@ MICS_AXES: Tuple[str, ...] = ("data_inner", "expert")
 _CURRENT_MESH: Optional[Mesh] = None
 
 
+def _hybrid_device_array(devices, shape: Dict[str, int],
+                         dcn: Dict[str, int],
+                         slice_ids: Sequence[int]) -> np.ndarray:
+    """Lay devices out so DCN (cross-slice) hops land ONLY on the axes
+    named in ``dcn`` — the multi-slice analogue of the reference's
+    node-local hierarchy (MiCS hpZ sub-groups, hierarchical allgather).
+
+    Each axis of size S with a DCN factor f splits into f outer (slice-
+    crossing) blocks of S/f ICI-contiguous indices; axes without a DCN
+    factor stay entirely within one slice, so their collectives never
+    touch the data-center network.
+    """
+    order = sorted(set(slice_ids))
+    n_slices = len(order)
+    groups = {s: [d for d, sid in zip(devices, slice_ids) if sid == s]
+              for s in order}
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"uneven slices: {sorted(sizes)} devices/slice")
+    dcn_shape = tuple(dcn.get(ax, 1) for ax in MESH_AXES)
+    if int(np.prod(dcn_shape)) != n_slices:
+        raise ValueError(
+            f"dcn factors {dict(dcn)} multiply to "
+            f"{int(np.prod(dcn_shape))} but {n_slices} slices detected")
+    ici_shape = []
+    for ax in MESH_AXES:
+        f = dcn.get(ax, 1)
+        if shape[ax] % f:
+            raise ValueError(f"axis '{ax}' size {shape[ax]} not "
+                             f"divisible by its dcn factor {f}")
+        ici_shape.append(shape[ax] // f)
+    per_slice = len(groups[order[0]])
+    if per_slice != int(np.prod(ici_shape)):
+        raise ValueError(
+            f"{per_slice} devices/slice != ICI axes product "
+            f"{int(np.prod(ici_shape))}")
+    full = np.empty(tuple(shape[ax] for ax in MESH_AXES), dtype=object)
+    for lin, dcn_coord in enumerate(np.ndindex(dcn_shape)):
+        block = np.array(groups[order[lin]], dtype=object
+                         ).reshape(ici_shape)
+        idx = tuple(slice(c * i, (c + 1) * i)
+                    for c, i in zip(dcn_coord, ici_shape))
+        full[idx] = block
+    return full
+
+
 def build_mesh(data: Optional[int] = None,
                model: int = 1,
                pipe: int = 1,
@@ -48,12 +94,22 @@ def build_mesh(data: Optional[int] = None,
                expert: int = 1,
                data_inner: int = 1,
                devices: Optional[Sequence[jax.Device]] = None,
+               dcn: Optional[Dict[str, int]] = None,
+               slice_ids: Optional[Sequence[int]] = None,
                set_current: bool = True) -> Mesh:
     """Build the framework mesh.
 
     ``data=None`` infers the data-parallel degree from the device count
     (reference analogue: world_size / (tp×pp×sp×ep)). ``data_inner`` is
     the MiCS/hpZ sub-group size (divides the total DP degree).
+
+    Multi-slice (DCN-connected) topologies: pass ``dcn={axis: factor}``
+    naming which axes cross slice boundaries (factors must multiply to
+    the slice count). All other axes stay ICI-local. ``slice_ids``
+    overrides per-device slice detection (``device.slice_index``) — used
+    by tests on CPU meshes. With multiple slices and no ``dcn``, the
+    outermost nontrivial axis divisible by the slice count is chosen
+    (pipe, then data) and logged.
     """
     if devices is None:
         devices = jax.devices()
@@ -71,14 +127,40 @@ def build_mesh(data: Optional[int] = None,
             f"mesh axes product {total} != device count {n} "
             f"(pipe={pipe} data={data} data_inner={data_inner} "
             f"expert={expert} seq={seq} model={model})")
-    arr = np.array(devices[:total]).reshape(pipe, data, data_inner,
-                                            expert, seq, model)
+    devices = list(devices[:total])
+    shape = {"pipe": pipe, "data": data, "data_inner": data_inner,
+             "expert": expert, "seq": seq, "model": model}
+    if slice_ids is None:
+        slice_ids = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    n_slices = len(set(slice_ids))
+    if n_slices > 1:
+        if dcn is None:
+            for ax in ("pipe", "data"):
+                if shape[ax] % n_slices == 0 and shape[ax] >= n_slices:
+                    dcn = {ax: n_slices}
+                    break
+            else:
+                raise ValueError(
+                    f"{n_slices} slices but neither pipe={pipe} nor "
+                    f"data={data} is divisible by the slice count; pass "
+                    f"dcn={{axis: factor}} explicitly")
+            logger.info(f"multi-slice topology ({n_slices} slices): "
+                        f"auto-assigned DCN axis {dcn}")
+        arr = _hybrid_device_array(devices, shape, dcn, slice_ids)
+    else:
+        if dcn and any(v > 1 for v in dcn.values()):
+            raise ValueError(f"dcn={dict(dcn)} given but only one slice "
+                             f"detected")
+        arr = np.array(devices).reshape(pipe, data, data_inner,
+                                        expert, seq, model)
     mesh = Mesh(arr, MESH_AXES)
     if set_current:
         set_mesh(mesh)
     log_dist(f"built mesh: pipe={pipe} data={data} "
              f"data_inner={data_inner} expert={expert} "
-             f"seq={seq} model={model}")
+             f"seq={seq} model={model}"
+             + (f" over {n_slices} slices, dcn={dict(dcn)}"
+                if n_slices > 1 else ""))
     return mesh
 
 

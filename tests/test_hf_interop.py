@@ -233,13 +233,35 @@ def test_gemma_export_roundtrip(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
 
 
-def test_export_rejects_unsupported_layout(tmp_path):
-    """A layout no HF family can express (RMSNorm + learned positions)
-    must raise, not write a silently-wrong checkpoint."""
+@pytest.mark.parametrize("overrides", [
+    # no HF family: RMSNorm + learned positions
+    dict(norm="rmsnorm", pos_emb="learned", activation="gelu",
+         use_bias=False),
+    # parallel-residual GLU: llama layouts are sequential — must not
+    # export as 'llama' and silently reload sequential
+    dict(norm="rmsnorm", pos_emb="rope", activation="silu_glu",
+         use_bias=False, parallel_block=True, parallel_block_norms=2),
+    # bias-less learned-pos model: gpt2/opt layouts are all-bias
+    dict(norm="layernorm", pos_emb="learned", activation="gelu",
+         use_bias=False),
+    # untied head WITH bias on a layout that has no lm_head.bias slot
+    dict(norm="layernorm", pos_emb="learned", activation="gelu",
+         use_bias=True, tie_embeddings=False, lm_head_bias=True),
+    # GLU falcon-shape: dense_h_to_4h has no gate slot
+    dict(norm="layernorm", pos_emb="rope", activation="silu_glu",
+         use_bias=False, parallel_block=True, parallel_block_norms=2),
+    # partial-rotary biased GQA parallel model: falcon config has no
+    # partial_rotary field, neox route excludes GQA
+    dict(norm="layernorm", pos_emb="rope", activation="gelu_exact",
+         use_bias=True, parallel_block=True, parallel_block_norms=2,
+         num_kv_heads=2, rotary_pct=0.5),
+])
+def test_export_rejects_unsupported_layout(overrides, tmp_path):
+    """Layouts no HF family can express must raise, not write a
+    silently-wrong checkpoint."""
     cfg = transformer.DecoderConfig(
         hidden_size=64, num_layers=2, num_heads=4, vocab_size=256,
-        max_seq_len=64, norm="rmsnorm", pos_emb="learned",
-        activation="gelu", use_bias=False)
+        max_seq_len=64, **overrides)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises((ValueError, NotImplementedError)):
         export_hf_checkpoint(cfg, params, str(tmp_path / "nope"))
