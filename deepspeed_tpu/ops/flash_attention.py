@@ -13,6 +13,14 @@ maps query-head index -> kv-head index inside the BlockSpec index maps, so
 K/V are never repeated in memory. fp32 accumulation on the MXU
 (preferred_element_type), bf16 inputs.
 
+Two kernel generations, auto-dispatched on local sequence length:
+- resident (tk*d*itemsize ≤ 2 MiB, i.e. up to 8K at d=128 bf16): whole
+  K/V per program, causal fori_loop bound skips dead blocks and their
+  fetches — fastest.
+- XL: (bh, nq, nk) grid with kv innermost, online-softmax state in VMEM
+  scratch — no sequence ceiling (128K+ local seq; the Ulysses-128K
+  config needs 16K+ per chip at SP=8).
+
 Falls back to the XLA reference implementation (models.transformer.
 dot_product_attention) off-TPU or for shapes the kernel doesn't cover.
 """
@@ -29,6 +37,24 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def _mask_scores(s, q_start, k_start, causal: bool,
+                 window) -> "jax.Array":
+    """Apply the causal and/or sliding-window visibility mask to one
+    [BQ, BK] score tile (the ONE home for the mask inequalities — used by
+    every fwd/bwd kernel generation)."""
+    if not causal and window is None:
+        return s
+    block_q, block_k = s.shape
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    ok = (qpos >= kpos) if causal else \
+        jnp.full_like(qpos, True, dtype=jnp.bool_)
+    if window is not None:
+        ok = jnp.logical_and(ok, kpos > qpos - window)
+    return jnp.where(ok, s, _NEG_INF)
+
 
 # swept on v5e (1.27B llama, seq 2048): 512/512 → 51.3% MFU vs 47.9% at
 # 256/256 and 50.9% at 1024/512 — bigger q tiles amortize the softmax
@@ -80,14 +106,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            kpos = kb * block_k + \
-                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            ok = (qpos >= kpos) if causal else \
-                jnp.full_like(qpos, True, dtype=jnp.bool_)
-            if window is not None:
-                ok = jnp.logical_and(ok, kpos > qpos - window)
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _mask_scores(s, q_start, kb * block_k, causal, window)
         blk_max = jnp.max(s, axis=1)                        # [BQ]
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m[:, None])
@@ -116,6 +135,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, window,
          interpret):
+    if not _resident_ok(q.shape[1], k.shape[1], q.shape[2],
+                        q.dtype.itemsize):
+        return _fwd_xl(q, k, v, scale, causal, q_offset, block_q, block_k,
+                       window, interpret)
     bh, tq, d = q.shape
     bkv, tk, _ = k.shape
     g = bh // bkv
@@ -138,6 +161,114 @@ def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k, window,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# XL forward kernel — KV-blocked grid for long sequences.
+#
+# The resident kernel above keeps whole K/V per program (fastest at tk
+# ≤ ~8K: the causal fori_loop bound skips dead blocks AND their fetches).
+# Past that the (1, tk, d) BlockSpec overflows VMEM, so this variant runs
+# a (bh, nq, nk) grid with the kv dimension innermost and carries the
+# online-softmax state (acc, m, l) in VMEM scratch across kv steps —
+# the standard FA2 TPU structure (compare jax.experimental.pallas.ops.
+# tpu.flash_attention; re-derived here). Causally-dead (i, j) programs
+# skip compute via pl.when (their block DMA still happens — the price of
+# a rectangular grid — so the resident path stays the default).
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_xl(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                   l_ref, *, scale: float, causal: bool, q_offset: int,
+                   window: Optional[int], num_kb: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_start = i * block_q + q_offset
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    live = jnp.bool_(True)
+    if causal:   # block intersects the causal triangle
+        live = jnp.logical_and(live, k_start <= q_start + block_q - 1)
+    if window is not None:   # block not entirely left of the window
+        live = jnp.logical_and(live,
+                               k_start + block_k - 1 > q_start - window)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = _mask_scores(s, q_start, k_start, causal, window)
+        m = m_ref[...]
+        blk_max = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m, blk_max)
+        new_m_col = new_m[:, None]
+        p = jnp.exp(s - new_m_col)
+        # Mosaic can't minor-dim-reshape i1 vectors — compare the already
+        # 2-D f32 column instead of reshaping a 1-D bool
+        p = jnp.where(new_m_col > _NEG_INF / 2, p, 0.0)
+        alive = new_m > _NEG_INF / 2
+        corr = jnp.where(alive, jnp.exp(m - new_m), 0.0)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1)
+        m_ref[...] = new_m
+
+    @pl.when(j == num_kb - 1)
+    def _flush():
+        l = l_ref[...]
+        m = m_ref[...]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_ref[...] / safe_l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = jnp.where(
+            m > _NEG_INF / 2, m + jnp.log(safe_l), _NEG_INF)
+
+
+def _fwd_xl(q, k, v, scale, causal, q_offset, block_q, block_k, window,
+            interpret):
+    bh, tq, d = q.shape
+    bkv, tk, _ = k.shape
+    g = bh // bkv
+    num_kb = tk // block_k
+    grid = (bh, tq // block_q, num_kb)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_xl, scale=scale, causal=causal,
+                          q_offset=q_offset, window=window, num_kb=num_kb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=g: (lax.div(b, g), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=g: (lax.div(b, g), j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -182,14 +313,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            kpos = kb * block_k + \
-                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            ok = (qpos >= kpos) if causal else \
-                jnp.full_like(qpos, True, dtype=jnp.bool_)
-            if window is not None:
-                ok = jnp.logical_and(ok, kpos > qpos - window)
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _mask_scores(s, q_start, kb * block_k, causal, window)
         p = jnp.exp(s - lse[:, None])
         dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -242,14 +366,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            qpos = qb * block_q + q_offset + \
-                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            ok = (qpos >= kpos) if causal else \
-                jnp.full_like(qpos, True, dtype=jnp.bool_)
-            if window is not None:
-                ok = jnp.logical_and(ok, kpos > qpos - window)
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _mask_scores(s, qb * block_q + q_offset, k_start, causal,
+                         window)
         p = jnp.exp(s - lse[:, None])
         dv = dv + lax.dot_general(p.astype(do.dtype), do,
                                   (((0,), (0,)), ((), ())),
@@ -270,6 +388,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
          window, interpret):
+    if not _resident_ok(q.shape[1], k.shape[1], q.shape[2],
+                        q.dtype.itemsize):
+        return _bwd_xl(q, k, v, out, lse, do, scale, causal, q_offset,
+                       block_q, block_k, window, interpret)
     bh, tq, d = q.shape
     bkv, tk, _ = k.shape
     g = bh // bkv
@@ -328,6 +450,171 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k,
 
 
 # ---------------------------------------------------------------------------
+# XL backward kernels — KV/Q-blocked grids mirroring _fwd_kernel_xl
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel_xl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_acc_ref, *, scale: float, causal: bool,
+                      q_offset: int, window: Optional[int], num_kb: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_start = i * block_q + q_offset
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    live = jnp.bool_(True)
+    if causal:
+        live = jnp.logical_and(live, k_start <= q_start + block_q - 1)
+    if window is not None:
+        live = jnp.logical_and(live,
+                               k_start + block_k - 1 > q_start - window)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = _mask_scores(s, q_start, k_start, causal, window)
+        p = jnp.exp(s - lse[:, None])
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k_blk.dtype)
+        dq_acc_ref[...] = dq_acc_ref[...] + lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kb - 1)
+    def _flush():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_xl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                       scale: float, causal: bool, q_offset: int,
+                       window: Optional[int], num_qb: int):
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    k_start = jk * block_k
+    q_start = iq * block_q + q_offset
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    live = jnp.bool_(True)
+    if causal:   # some query in the block reaches this k block
+        live = jnp.logical_and(live, q_start + block_q - 1 >= k_start)
+    if window is not None:
+        live = jnp.logical_and(live,
+                               k_start + block_k - 1 > q_start - window)
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        q_blk = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = _mask_scores(s, q_start, k_start, causal, window)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc_ref[...] = dv_acc_ref[...] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q_blk.dtype)
+        dk_acc_ref[...] = dk_acc_ref[...] + lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == num_qb - 1)
+    def _flush():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _bwd_xl(q, k, v, out, lse, do, scale, causal, q_offset, block_q,
+            block_k, window, interpret):
+    bh, tq, d = q.shape
+    bkv, tk, _ = k.shape
+    g = bh // bkv
+    num_kb = tk // block_k
+    num_qb = tq // block_q
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]                      # [BH, 1, TQ]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_xl, scale=scale, causal=causal,
+                          q_offset=q_offset, window=window, num_kb=num_kb),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=g: (lax.div(b, g), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=g: (lax.div(b, g), j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_xl, scale=scale, causal=causal,
+                          q_offset=q_offset, window=window, num_qb=num_qb),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, jk, iq: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, jk, iq, g=g: (lax.div(b, g), jk, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, jk, iq, g=g: (lax.div(b, g), jk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, jk, iq: (b, iq, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, jk, iq: (b, 0, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda b, jk, iq: (b, 0, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, jk, iq: (b, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, jk, iq: (b, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if g > 1:
+        dk = dk_h.reshape(bkv, g, tk, d).sum(axis=1)
+        dv = dv_h.reshape(bkv, g, tk, d).sum(axis=1)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
@@ -357,18 +644,24 @@ def _flash_bwd(causal, q_offset, block_q, block_k, window, interpret, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-#: per-tensor VMEM budget for the full-K/V-resident BlockSpecs (a core has
-#: ~16 MiB; K+V+Q/dO tiles must co-reside, so cap each at 4 MiB)
-_VMEM_PER_TENSOR = 4 * 1024 * 1024
+#: per-tensor VMEM budget for the full-K/V-resident BlockSpecs. A core has
+#: ~16 MiB and Pallas DOUBLE-BUFFERS revisited blocks, so the dq kernel's
+#: K+V residency costs ~4x this bound in stack VMEM (measured: 16.75 MiB
+#: at tk=16K/d=128 under a 4 MiB bound → compile OOM). 2 MiB keeps the
+#: fast resident kernels through tk=8K at d=128; beyond that the XL
+#: (KV-blocked-grid) kernels take over — no sequence ceiling.
+_VMEM_PER_TENSOR = 2 * 1024 * 1024
 
 
-def _supported(tq, tk, d, block_q, block_k, itemsize=2) -> bool:
-    if not (tq % block_q == 0 and tk % block_k == 0 and
-            tq >= block_q and tk >= block_k and d <= 256):
-        return False
-    # the fwd/bwd kernels keep whole K/V (and Q/dO in the dkv kernel)
-    # resident per program — bound it or fall back to XLA
+def _resident_ok(tq, tk, d, itemsize=2) -> bool:
+    """Whole-K/V-per-program kernels fit VMEM (the fast path: the causal
+    fori_loop bound skips dead blocks AND their fetches)."""
     return max(tq, tk) * d * itemsize <= _VMEM_PER_TENSOR
+
+
+def _supported(tq, tk, d, block_q, block_k) -> bool:
+    return (tq % block_q == 0 and tk % block_k == 0 and
+            tq >= block_q and tk >= block_k and d <= 256)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -390,25 +683,26 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     _, tk, kvh, _ = k.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # env knobs for offline block tuning (bench.py sweeps these)
+    # env knobs for offline block tuning (bench.py sweeps these). The XL
+    # grid amortizes its per-(i,j)-program overhead over bigger tiles:
+    # 1024/1024 measured 44.8% vs 36.0% MFU at 512/512 (seq 16K, v5e)
     import os
+    xl = not _resident_ok(tq, tk, d, q.dtype.itemsize)
+    default_bq = 1024 if xl else DEFAULT_BLOCK_Q
+    default_bk = 1024 if xl else DEFAULT_BLOCK_K
     bq = block_q or int(os.environ.get("DSTPU_FLASH_BQ", 0)) or \
-        min(DEFAULT_BLOCK_Q, tq)
+        min(default_bq, tq)
     bk = block_k or int(os.environ.get("DSTPU_FLASH_BK", 0)) or \
-        min(DEFAULT_BLOCK_K, tk)
+        min(default_bk, tk)
     bq, bk = min(bq, tq), min(bk, tk)
     # step blocks down before abandoning the kernel: e.g. tq=768 doesn't
     # divide by the 512 default but runs fine (and much faster than the
     # XLA fallback) at 256
-    while bq > 128 and (tq % bq or
-                        not _supported(tq, tk, d, bq, bk,
-                                       q.dtype.itemsize)):
+    while bq > 128 and (tq % bq or not _supported(tq, tk, d, bq, bk)):
         bq //= 2
-    while bk > 128 and (tk % bk or
-                        not _supported(tq, tk, d, bq, bk,
-                                       q.dtype.itemsize)):
+    while bk > 128 and (tk % bk or not _supported(tq, tk, d, bq, bk)):
         bk //= 2
-    if not _supported(tq, tk, d, bq, bk, q.dtype.itemsize) or h % kvh:
+    if not _supported(tq, tk, d, bq, bk) or h % kvh:
         from deepspeed_tpu.models.transformer import dot_product_attention
         from deepspeed_tpu.utils.logging import logger
         logger.warning(

@@ -109,13 +109,20 @@ def test_falcon_ln_bias_without_linear_bias():
     assert cfg.ln_bias and not cfg.use_bias
 
 
-def test_export_rejects_parallel_block(tmp_path):
+def test_export_supports_parallel_block(tmp_path):
+    """Parallel-residual (falcon) export used to be rejected; it now
+    writes a model_type=falcon checkpoint (roundtrip parity is covered in
+    test_hf_interop.py::test_classic_export_roundtrip)."""
+    import json
+    import os
     from deepspeed_tpu.models.hf_loader import export_hf_checkpoint
     from deepspeed_tpu.models.transformer import init_params
     cfg = falcon_config("tiny")
     p = init_params(cfg, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="parallel"):
-        export_hf_checkpoint(cfg, p, str(tmp_path))
+    out = str(tmp_path / "falcon_out")
+    export_hf_checkpoint(cfg, p, out)
+    with open(os.path.join(out, "config.json")) as fh:
+        assert json.load(fh)["model_type"] == "falcon"
 
 
 def test_registered_attention_rejects_sp(devices):
