@@ -31,7 +31,8 @@ Params = Any
 # ---------------------------------------------------------------------------
 
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox",
-              "gemma", "gpt2", "opt", "bloom", "falcon", "phi")
+              "gemma", "gpt2", "opt", "bloom", "falcon", "phi",
+              "gpt_bigcode")
 
 
 def _map_hf_act(act: str) -> str:
@@ -80,6 +81,23 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             norm="layernorm",
             activation=_map_hf_act(hf.get("activation_function",
                                           "gelu_new")),
+            pos_emb="learned",
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+    if mt == "gpt_bigcode":
+        H = hf["n_head"]
+        return DecoderConfig(
+            hidden_size=hf["n_embd"],
+            num_layers=hf["n_layer"],
+            num_heads=H,
+            num_kv_heads=1 if hf.get("multi_query", True) else H,
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("n_positions", 1024),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation_function",
+                                          "gelu_pytorch_tanh")),
             pos_emb="learned",
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             use_bias=True,
@@ -206,7 +224,7 @@ def _is_gemma_layout(cfg: DecoderConfig) -> bool:
 
 
 def _no_exotics(cfg: DecoderConfig) -> bool:
-    """Features NO classic (gpt2/opt/bloom/falcon/phi/neox) HF layout has
+    """Features NO classic (gpt2/bigcode/opt/bloom/falcon/phi/neox) HF layout has
     a slot for — a config carrying any of them must NOT match those
     branches, or the export silently drops the feature."""
     return (not cfg.num_experts and cfg.head_dim_override is None
@@ -263,7 +281,18 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
     if (cfg.norm == "layernorm" and cfg.pos_emb == "learned"
             and cfg.use_bias and not cfg.parallel_block
             and _no_exotics(cfg) and not cfg.embed_norm
-            and not untied_bias):   # no lm_head.bias slot in gpt2/opt
+            and not untied_bias   # no lm_head.bias slot in these layouts
+            and cfg.kv_heads in (1, cfg.num_heads)):
+        if cfg.kv_heads == 1 and cfg.num_heads > 1:   # MQA → bigcode
+            return {**base, "model_type": "gpt_bigcode",
+                    "architectures": ["GPTBigCodeForCausalLM"],
+                    "n_embd": cfg.hidden_size, "n_layer": cfg.num_layers,
+                    "n_head": cfg.num_heads,
+                    "n_positions": cfg.max_seq_len,
+                    "n_inner": cfg.ffn_size, "multi_query": True,
+                    "layer_norm_epsilon": cfg.norm_eps,
+                    "activation_function":
+                        act_name("gelu", "gelu_pytorch_tanh")}
         if cfg.activation == "relu":   # OPT lineage
             return {**base, "model_type": "opt",
                     "architectures": ["OPTForCausalLM"],
@@ -347,7 +376,7 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             f"pos_emb={cfg.pos_emb} activation={cfg.activation} "
             f"parallel_block={cfg.parallel_block}; supported exports: "
             f"llama/mistral/mixtral/qwen2-like, gemma, gpt_neox, gpt2, "
-            f"opt, bloom, falcon, phi")
+            f"gpt_bigcode, opt, bloom, falcon, phi")
     if _is_gemma_layout(cfg):
         mt = "gemma"
         arch = ["GemmaForCausalLM"]
@@ -442,6 +471,8 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         return cfg, _load_neox(cfg, get, dtype)
     if mt == "gpt2":
         return cfg, _load_gpt2(cfg, get, names, dtype)
+    if mt == "gpt_bigcode":
+        return cfg, _load_bigcode(cfg, get, names, dtype)
     if mt == "opt":
         return cfg, _load_opt(cfg, get, names, dtype)
     if mt == "bloom":
@@ -637,6 +668,63 @@ def _load_gpt2(cfg: DecoderConfig, get, names, dtype) -> Params:
             "wi": stack(p + "mlp.c_fc.weight"),
             "bi": stack(p + "mlp.c_fc.bias"),
             "wo": stack(p + "mlp.c_proj.weight"),
+            "bo": stack(p + "mlp.c_proj.bias"),
+        },
+    }
+    return _attach_untied_head({
+        "embed": {"tokens": get("transformer.wte.weight").astype(dtype),
+                  "pos": get("transformer.wpe.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dtype),
+            "bias": get("transformer.ln_f.bias").astype(dtype)},
+    }, cfg, get, names, dtype)
+
+
+def _load_bigcode(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """GPT-BigCode (SantaCoder/StarCoder) layout: GPT-2 names but
+    nn.Linear ([out, in]) weights. Fused c_attn packing differs by
+    variant: MQA = q | 1-head k | 1-head v concatenated on the out dim;
+    MHA (multi_query=False) = NeoX-style HEAD-INTERLEAVED [H, 3, dh]."""
+    L, D, H, dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                   cfg.head_dim)
+    kvd = cfg.kv_heads * dh
+    p = "transformer.h.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+
+    def split_attn(i):
+        w = np.ascontiguousarray(
+            get(p.format(i) + "attn.c_attn.weight").astype(dtype).T)
+        b = get(p.format(i) + "attn.c_attn.bias").astype(dtype)
+        if cfg.kv_heads == 1:   # MQA concat
+            return ((w[:, :D], w[:, D:D + kvd], w[:, D + kvd:]),
+                    (b[:D], b[D:D + kvd], b[D + kvd:]))
+        wi = w.reshape(D, H, 3, dh)
+        bi = b.reshape(H, 3, dh)
+        return (tuple(np.ascontiguousarray(wi[:, :, j].reshape(D, H * dh))
+                      for j in range(3)),
+                tuple(bi[:, j].reshape(-1) for j in range(3)))
+
+    ws, bs = zip(*(split_attn(i) for i in range(L)))
+    layers = {
+        "attn": {
+            "wq": np.stack([w[0] for w in ws]),
+            "wk": np.stack([w[1] for w in ws]),
+            "wv": np.stack([w[2] for w in ws]),
+            "wo": stackT(p + "attn.c_proj.weight"),
+            "bq": np.stack([b[0] for b in bs]),
+            "bk": np.stack([b[1] for b in bs]),
+            "bv": np.stack([b[2] for b in bs]),
+            "bo": stack(p + "attn.c_proj.bias"),
+        },
+        "ln1": {"scale": stack(p + "ln_1.weight"),
+                "bias": stack(p + "ln_1.bias")},
+        "ln2": {"scale": stack(p + "ln_2.weight"),
+                "bias": stack(p + "ln_2.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.c_fc.weight"),
+            "bi": stack(p + "mlp.c_fc.bias"),
+            "wo": stackT(p + "mlp.c_proj.weight"),
             "bo": stack(p + "mlp.c_proj.bias"),
         },
     }
@@ -867,7 +955,8 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     if _is_neox_layout(cfg):
         return _export_neox(cfg, params, out_dir)
     cfg_hf = config_to_hf(cfg)   # raises on unsupported layouts
-    if cfg_hf["model_type"] in ("gpt2", "opt", "bloom", "falcon", "phi"):
+    if cfg_hf["model_type"] in ("gpt2", "opt", "bloom", "falcon", "phi",
+                                "gpt_bigcode"):
         return _export_classic(cfg, cfg_hf, params, out_dir)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -953,8 +1042,8 @@ def _fuse_interleaved(a: Params, i: int, H: int, dh: int, D: int):
 
 def _export_classic(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
                     params: Params, out_dir: str) -> None:
-    """Reverse mappings for the classic families (GPT-2/OPT/BLOOM/Falcon/
-    Phi) — each the inverse of its ``_load_*`` including the fused-qkv
+    """Reverse mappings for the classic families (GPT-2/GPT-BigCode/OPT/
+    BLOOM/Falcon/Phi) — each the inverse of its ``_load_*`` including the fused-qkv
     re-pack and OPT's +2 position rows."""
     import jax
     host = jax.tree.map(
@@ -987,6 +1076,28 @@ def _export_classic(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
             out[p + "mlp.c_fc.weight"] = m["wi"][i]
             out[p + "mlp.c_fc.bias"] = m["bi"][i]
             out[p + "mlp.c_proj.weight"] = m["wo"][i]
+            out[p + "mlp.c_proj.bias"] = m["bo"][i]
+            put_ln(p + "ln_1", lyr["ln1"], i)
+            put_ln(p + "ln_2", lyr["ln2"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "gpt_bigcode":
+        out["transformer.wte.weight"] = host["embed"]["tokens"]
+        out["transformer.wpe.weight"] = host["embed"]["pos"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            # nn.Linear [out, in]: concat q|k|v on OUT then transpose back
+            out[p + "attn.c_attn.weight"] = C(np.concatenate(
+                [a["wq"][i], a["wk"][i], a["wv"][i]], axis=1).T)
+            out[p + "attn.c_attn.bias"] = np.concatenate(
+                [a["bq"][i], a["bk"][i], a["bv"][i]])
+            out[p + "attn.c_proj.weight"] = C(a["wo"][i].T)
+            out[p + "attn.c_proj.bias"] = a["bo"][i]
+            out[p + "mlp.c_fc.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.c_fc.bias"] = m["bi"][i]
+            out[p + "mlp.c_proj.weight"] = C(m["wo"][i].T)
             out[p + "mlp.c_proj.bias"] = m["bo"][i]
             put_ln(p + "ln_1", lyr["ln1"], i)
             put_ln(p + "ln_2", lyr["ln2"], i)

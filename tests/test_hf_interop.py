@@ -504,3 +504,61 @@ def test_falcon_bias_one_norm_exports_as_phi(tmp_path):
     with torch.no_grad():
         theirs = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt_bigcode_logits_parity(tmp_path):
+    """StarCoder/SantaCoder: GPT-2 names but nn.Linear weights and MQA
+    fused c_attn (q | 1-head k | 1-head v on the out dim)."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+    cfg = GPTBigCodeConfig(n_embd=64, n_layer=2, n_head=4, vocab_size=256,
+                           n_positions=128, multi_query=True)
+    torch.manual_seed(14)
+    model = GPTBigCodeForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_bigcode")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.kv_heads == 1 and got.pos_emb == "learned"
+
+
+def test_gpt_bigcode_mha_logits_parity(tmp_path):
+    """multi_query=False variant: fused c_attn is HEAD-INTERLEAVED
+    [H, 3, dh] on the out dim (NOT GPT-2's columnwise concat), and
+    nn.Linear, so transposed."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+    cfg = GPTBigCodeConfig(n_embd=64, n_layer=2, n_head=4, vocab_size=256,
+                           n_positions=128, multi_query=False)
+    torch.manual_seed(15)
+    model = GPTBigCodeForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_bigcode_mha")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.kv_heads == got.num_heads
+
+
+def test_gpt_bigcode_export_roundtrip(tmp_path):
+    from deepspeed_tpu.models.gpt_bigcode import gpt_bigcode_config
+    from transformers import AutoModelForCausalLM
+    cfg = gpt_bigcode_config("tiny")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(16))
+    out = str(tmp_path / "export_bigcode")
+    export_hf_checkpoint(cfg, params, out)
+    with open(os.path.join(out, "config.json")) as fh:
+        assert json.load(fh)["model_type"] == "gpt_bigcode"
+    hf = AutoModelForCausalLM.from_pretrained(out).eval()
+    tokens = np.arange(3, 17, dtype=np.int32)[None]
+    ours = np.asarray(transformer.forward(cfg, params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_gqa_learned_pos_export_rejected(tmp_path):
+    """1 < kv < H with learned positions fits neither gpt2 (kv==H) nor
+    bigcode (kv==1) — must raise."""
+    cfg = transformer.DecoderConfig(
+        hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        vocab_size=256, max_seq_len=64, norm="layernorm",
+        activation="gelu", pos_emb="learned", use_bias=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises((ValueError, NotImplementedError)):
+        export_hf_checkpoint(cfg, params, str(tmp_path / "nope"))
