@@ -35,6 +35,9 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--n-prompts", type=int, default=16)
     ap.add_argument("--no-pallas", action="store_true")
+    ap.add_argument("--quant", action="store_true",
+                    help="int8 weight-only serving (halves decode weight"
+                         " fetch)")
     args = ap.parse_args()
 
     import jax
@@ -62,8 +65,9 @@ def main() -> None:
     new = args.new_tokens
 
     # ---- padded v1: one batch padded to the longest prompt
-    v1 = init_inference(model, {"dtype": dtype}, params=params,
-                        rng=jax.random.PRNGKey(0))
+    wq = "int8" if args.quant else None
+    v1 = init_inference(model, {"dtype": dtype, "weight_quant": wq},
+                        params=params, rng=jax.random.PRNGKey(0))
     width = int(max(lens))
     padded = np.zeros((args.n_prompts, width), np.int32)
     for i, p in enumerate(prompts):
@@ -78,9 +82,10 @@ def main() -> None:
     v2 = RaggedInferenceEngineTPU(
         model, {"dtype": dtype, "num_blocks": 512, "block_size": 64,
                 "max_seq_len": seq_cap, "prefill_chunk": 512,
-                "max_batch_tokens": 4096,
+                "max_batch_tokens": 4096, "weight_quant": wq,
                 "use_pallas": (False if args.no_pallas else None)},
-        params=v1.params, rng=jax.random.PRNGKey(0))
+        params=None if args.quant else v1.params,
+        rng=jax.random.PRNGKey(0))
     v2.generate(prompts, max_new_tokens=new)             # compile real buckets
     t_ragged = min(_timed(lambda: v2.generate(prompts, max_new_tokens=new))
                    for _ in range(3))
@@ -88,7 +93,8 @@ def main() -> None:
     gen_tokens = args.n_prompts * new
     result = {
         "metric": f"ragged vs padded decode llama3-{size} "
-                  f"{args.n_prompts} mixed-length prompts",
+                  f"{args.n_prompts} mixed-length prompts"
+                  + (" int8" if args.quant else ""),
         "value": round(gen_tokens / t_ragged, 2),
         "unit": "gen tokens/s (ragged)",
         "vs_baseline": round(t_padded / t_ragged, 4),

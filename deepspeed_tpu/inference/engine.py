@@ -41,6 +41,11 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
     max_batch_size: int = 8
     replace_with_kernel_inject: bool = False   # parity no-op: jit fuses
     min_out_tokens: int = 1
+    #: "int8" = weight-only quantized serving: matmul weights stored int8
+    #: with per-channel scales, dequantized in VMEM inside the Pallas
+    #: qmatmul. Halves weight HBM (serve ~2x larger models per chip);
+    #: see ops/quantized_linear.py for the measured speed tradeoff
+    weight_quant: Optional[str] = None
 
     @property
     def tp_size(self) -> int:
@@ -77,6 +82,11 @@ class InferenceEngineTPU:
             config = DeepSpeedTPUInferenceConfig(**(config or {}))
         self.model_config = model
         self.config = config
+        from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
+        validate_weight_quant(config.weight_quant)
+        if config.weight_quant and config.tp_size > 1:
+            raise ValueError("weight_quant=int8 requires tp_size=1 "
+                             "(quantized leaves are not TP-sharded)")
         if mesh is not None:
             self.mesh = mesh
         elif has_mesh():
@@ -106,6 +116,10 @@ class InferenceEngineTPU:
                              if jnp.issubdtype(x.dtype, jnp.floating)
                              else x, params),
                 self._param_sh)
+
+        if config.weight_quant:
+            from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+            self.params = quantize_param_tree(self.params)
 
         # KV cache sharded over batch (DP axes) and kv heads (model axis
         # when divisible)

@@ -48,6 +48,7 @@ class RaggedInferenceConfig(TPUConfigModel):
     max_batch_tokens: int = 2048     #: scheduler token budget per step
     prefill_chunk: int = 256         #: SplitFuse chunk width
     use_pallas: Optional[bool] = None  #: None = auto (TPU only)
+    weight_quant: Optional[str] = None  #: "int8" weight-only serving
 
 
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
@@ -174,6 +175,8 @@ class RaggedInferenceEngineTPU:
                 f"or use InferenceEngineTPU")
         self.model_config = model
         self.config = config
+        from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
+        validate_weight_quant(config.weight_quant)
         self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                       "float16": jnp.float16}[config.dtype]
         if config.use_pallas is None:
@@ -196,6 +199,9 @@ class RaggedInferenceEngineTPU:
             if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
         self.params = cast(params if params is not None
                            else init_params(model, rng))
+        if config.weight_quant:
+            from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+            self.params = quantize_param_tree(self.params)
         self.arena = pa.init_arena(model.num_layers, model.kv_heads,
                                    config.num_blocks, config.block_size,
                                    model.head_dim, self.dtype)

@@ -333,15 +333,33 @@ def resolve_remat_policy(name: Optional[str]):
 # Block
 # ---------------------------------------------------------------------------
 
+def linear_2d(x: jax.Array, p: Params, name: str) -> jax.Array:
+    """``x [..., K] @ p[name] [K, N]`` honoring int8 weight-only
+    quantization: a ``<name>_scale`` leaf (ops/quantized_linear.py
+    convention, attached by the inference engines' ``weight_quant``
+    config) routes through the Pallas dequant-in-VMEM matmul — weights
+    live in HBM at half the bytes (a memory-capacity feature; see the
+    measured tradeoffs in ops/quantized_linear.py). Without a scale
+    leaf this is a plain einsum (training path, fully
+    differentiable)."""
+    w = p[name]
+    if name + "_scale" not in p:
+        return jnp.einsum("...k,kn->...n", x, w)
+    from deepspeed_tpu.ops.quantized_linear import qmatmul
+    lead = x.shape[:-1]
+    out = qmatmul(x.reshape(-1, x.shape[-1]), w, p[name + "_scale"])
+    return out.reshape(*lead, w.shape[-1])
+
+
 def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
     if cfg.is_glu:
-        gate = jnp.einsum("btd,dh->bth", x, p["wg"])
-        up = jnp.einsum("btd,dh->bth", x, p["wi"])
+        gate = linear_2d(x, p, "wg")
+        up = linear_2d(x, p, "wi")
         act = jax.nn.silu(gate) if cfg.activation == "silu_glu" \
             else jax.nn.gelu(gate, approximate=True)
         hidden = act * up
     else:
-        hidden = jnp.einsum("btd,dh->bth", x, p["wi"])
+        hidden = linear_2d(x, p, "wi")
         if "bi" in p:
             hidden = hidden + p["bi"]
         if cfg.activation == "relu":
@@ -349,7 +367,7 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jax.Array) -> jax.Array:
         else:
             hidden = jax.nn.gelu(
                 hidden, approximate=cfg.activation != "gelu_exact")
-    out = jnp.einsum("bth,hd->btd", hidden, p["wo"])
+    out = linear_2d(hidden, p, "wo")
     if "bo" in p:
         out = out + p["bo"]
     return out
@@ -359,13 +377,10 @@ def qkv_project(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Shared projection for training and KV-cached inference:
     x [B,t,D] -> q [B,t,H,Dh], k/v [B,t,KvH,Dh] with bias + RoPE applied."""
-    d = x.shape[-1]
-    q = jnp.einsum("btd,dhk->bthk", x,
-                   p["wq"].reshape(d, cfg.num_heads, cfg.head_dim))
-    k = jnp.einsum("btd,dhk->bthk", x,
-                   p["wk"].reshape(d, cfg.kv_heads, cfg.head_dim))
-    v = jnp.einsum("btd,dhk->bthk", x,
-                   p["wv"].reshape(d, cfg.kv_heads, cfg.head_dim))
+    b, t = x.shape[:2]
+    q = linear_2d(x, p, "wq").reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = linear_2d(x, p, "wk").reshape(b, t, cfg.kv_heads, cfg.head_dim)
+    v = linear_2d(x, p, "wv").reshape(b, t, cfg.kv_heads, cfg.head_dim)
     if "bq" in p:
         q = q + p["bq"].reshape(cfg.num_heads, cfg.head_dim)
         k = k + p["bk"].reshape(cfg.kv_heads, cfg.head_dim)
@@ -381,9 +396,8 @@ def qkv_project(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos
 
 def attn_out_project(cfg: DecoderConfig, p: Params, out: jax.Array
                      ) -> jax.Array:
-    d = cfg.hidden_size
-    out = jnp.einsum("bthk,hkd->btd", out,
-                     p["wo"].reshape(cfg.num_heads, cfg.head_dim, d))
+    b, t = out.shape[:2]
+    out = linear_2d(out.reshape(b, t, cfg.q_dim), p, "wo")
     if "bo" in p:
         out = out + p["bo"]
     return out
@@ -557,9 +571,23 @@ def _softcap(cfg: DecoderConfig, logits: jax.Array) -> jax.Array:
 
 def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
     """Final projection: hidden [B,T,D] → logits [B,T,V] fp32."""
-    if cfg.tie_embeddings:
+    if "lm_head_q" in params:   # int8 logits copy (tied models, serving)
+        from deepspeed_tpu.ops.quantized_linear import qmatmul
+        b, t, d = x.shape
+        logits = qmatmul(x.reshape(b * t, d), params["lm_head_q"],
+                         params["lm_head_q_scale"],
+                         out_dtype=jnp.float32).reshape(b, t, -1)
+    elif cfg.tie_embeddings:
         logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
                             preferred_element_type=jnp.float32)
+    elif "lm_head_scale" in params:
+        from deepspeed_tpu.ops.quantized_linear import qmatmul
+        b, t, d = x.shape
+        logits = qmatmul(x.reshape(b * t, d), params["lm_head"],
+                         params["lm_head_scale"],
+                         out_dtype=jnp.float32).reshape(b, t, -1)
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(jnp.float32)
     else:
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                             preferred_element_type=jnp.float32)

@@ -1,0 +1,179 @@
+"""Int8 weight-only quantized serving (Pallas dequant-in-VMEM matmul).
+
+Reference analogue: the weight-quantized inference linears
+(inference/quantization/ + module_inject/module_quantize.py and the
+INT8 paths in csrc/quantization/). Quantization is symmetric
+per-output-channel (scale = max|w|/127 over the contraction dim) — the
+standard near-lossless weight-only recipe.
+
+What this buys on TPU — measured honestly on v5e (1.27B llama, batch
+16 decode, per-step time isolated from prefill):
+- **Memory capacity**: matmul weights at half the HBM — a chip serves
+  a ~2x larger model (the reason the reference ships INT8 inference).
+- **Decode-speed parity**: 7.77 ms/step int8 vs 7.85 ms/step bf16.
+  XLA's bf16 decode matmuls stream weights at ~320 GB/s on this chip;
+  the kernel's int8 stream (~160 GB/s of int8 ≈ 320 bf16-equivalent)
+  only reaches that WITH the `dimension_semantics` pipelining hint
+  (without it: 9.9 ms/step, 25% slower). The XLA alternative is worse:
+  `dot(x, w_int8.astype(bf16))` materializes the dequantized weight
+  (0.71x). A future >2x win needs int8 DMA to outpace bf16 — revisit
+  per libtpu generation.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.utils.logging import logger
+
+#: suffix convention: a params dict carrying ``<name>`` as int8 plus
+#: ``<name>_scale`` routes matmuls through qmatmul (transformer.linear_2d)
+SCALE_SUFFIX = "_scale"
+
+
+def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[K, N] float → (int8 [K, N], f32 scale [N]); symmetric per-output-
+    channel. Works on stacked [L, K, N] too (scale [L, N])."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_weight(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale[..., None, :]
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x_blk = x_ref[...]
+    # int8 → bf16 in VMEM; MXU accumulates fp32 (preferred_element_type)
+    w_blk = w_ref[...].astype(jnp.bfloat16)
+    acc_ref[...] += lax.dot_general(
+        x_blk, w_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
+
+
+def _qmm(x: jax.Array, w: jax.Array, scale: jax.Array, bm: int, bn: int,
+         bk: int, interpret: bool, out_dtype) -> jax.Array:
+    m, k = x.shape
+    _, n = w.shape
+    nk = k // bk
+    s2 = scale.astype(jnp.float32).reshape(1, n)
+    kw = {}
+    if not interpret:
+        # m/n grid dims are embarrassingly parallel; telling Mosaic so
+        # improves DMA pipelining (measured 4.57 -> 2.92 ms on the
+        # 24-layer decode chain probe)
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        functools.partial(_qmm_kernel, nk=nk),
+        grid=(m // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        **kw,
+    )(x, w, s2)
+
+
+def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+            out_dtype=None,
+            interpret: Optional[bool] = None) -> jax.Array:
+    """x [M, K] (bf16/f32) @ int8 w_q [K, N] with per-channel scale [N].
+
+    Pads M up to a sublane multiple; falls back to an XLA dequant matmul
+    off-TPU or for non-tileable K/N.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = x.shape
+    n = w_q.shape[1]
+    bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
+    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    out_dtype = out_dtype or x.dtype
+    if not bk or not bn:
+        logger.warning(
+            f"qmatmul: K={k}/N={n} not tileable; using XLA dequant path")
+        w = w_q.astype(jnp.float32) * scale[None, :]
+        return (x.astype(jnp.float32) @ w).astype(out_dtype)
+    mp = max(8, -(-m // 8) * 8)
+    bm = mp if mp <= 256 else 256
+    if mp % bm:
+        mp = -(-mp // bm) * bm
+    xp = x if mp == m else jnp.pad(x, ((0, mp - m), (0, 0)))
+    out = _qmm(xp, w_q, scale, bm, bn, bk, interpret, out_dtype)
+    return out[:m] if mp != m else out
+
+
+def validate_weight_quant(mode) -> None:
+    """Shared early validation for the engines' ``weight_quant`` knob —
+    fails before any parameter materialization."""
+    if mode is not None and mode != "int8":
+        raise ValueError(f"weight_quant '{mode}' unsupported; only 'int8'")
+
+
+def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
+                                         "wi")):
+    """Replace 2-D(+stacked) matmul leaves named in ``targets`` inside
+    ``params['layers']`` with (int8, ``<name>_scale``) pairs, quantize an
+    untied ``lm_head``, and for tied embeddings add a TRANSPOSED int8
+    logits copy ``lm_head_q`` [D, V] (the original embedding table stays
+    float for the token lookup; per-step HBM traffic is what matters and
+    the logits matmul only ever reads the int8 copy).
+
+    Inference-only: the quantized leaves carry no gradient path.
+    MoE models are rejected: the expert einsum dispatch has no
+    dequant path yet, and quantizing only attention would silently
+    under-deliver the promised memory halving.
+    """
+    if "moe" in params.get("layers", {}):
+        raise NotImplementedError(
+            "weight_quant=int8 does not cover MoE expert weights yet "
+            "(the GShard einsum dispatch has no dequant path); serve "
+            "MoE models unquantized")
+    out = {k: v for k, v in params.items()}
+    layers = {k: v for k, v in params["layers"].items()}
+    for group in ("attn", "mlp"):
+        if group not in layers:
+            continue
+        g = {k: v for k, v in layers[group].items()}
+        for name in targets:
+            if name in g and g[name].ndim >= 2 and \
+                    jnp.issubdtype(g[name].dtype, jnp.floating):
+                q, s = quantize_weight(g[name])
+                g[name] = q
+                g[name + SCALE_SUFFIX] = s
+        layers[group] = g
+    out["layers"] = layers
+    if "lm_head" in out:
+        q, s = quantize_weight(out["lm_head"])
+        out["lm_head"] = q
+        out["lm_head" + SCALE_SUFFIX] = s
+    else:
+        emb = out["embed"]["tokens"]           # [V, D] → logits copy [D, V]
+        q, s = quantize_weight(emb.T)
+        out["lm_head_q"] = q
+        out["lm_head_q" + SCALE_SUFFIX] = s
+    return out

@@ -1,0 +1,156 @@
+"""Int8 weight-only quantized serving (ops/quantized_linear.py).
+
+Reference analogue: inference/quantization/ + module_inject/
+module_quantize.py (weight-quantized inference linears) and the int8
+kernels under csrc/quantization/.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantized_linear import (dequantize_weight, qmatmul,
+                                                quantize_param_tree,
+                                                quantize_weight)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(256, 512)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w)
+    assert q.dtype == jnp.int8 and s.shape == (512,)
+    back = dequantize_weight(q, s)
+    # symmetric per-channel int8: error <= scale/2 = absmax/254 per elt
+    bound = np.asarray(jnp.max(jnp.abs(w), axis=0)) / 254 + 1e-8
+    err = np.abs(np.asarray(back - w))
+    assert (err <= bound[None, :] + 1e-7).all()
+
+
+def test_quantize_stacked_layers():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(4, 256, 512)), jnp.float32)
+    q, s = quantize_weight(w)
+    assert q.shape == w.shape and s.shape == (4, 512)
+
+
+@pytest.mark.parametrize("m", [1, 16, 100])
+def test_qmatmul_matches_dequant_reference(m):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(m, 256)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(256, 512)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w)
+    ref = x @ dequantize_weight(q, s)
+    out = qmatmul(x, q, s, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_qmatmul_untileable_falls_back():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 100)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(100, 300)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w)
+    out = qmatmul(x, q, s, interpret=True)
+    ref = x @ dequantize_weight(q, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def _logits(cfg, params, tokens):
+    from deepspeed_tpu.models import transformer
+    return np.asarray(transformer.forward(cfg, params,
+                                          jnp.asarray(tokens)))
+
+
+def test_quantized_forward_close_to_float(devices):
+    """Whole-model check: int8 weight-only logits stay close to the
+    float model (the near-lossless claim, and the wiring through
+    linear_2d/lm_logits)."""
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models import transformer
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256,
+                        tie_embeddings=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_param_tree(params)
+    assert qp["layers"]["attn"]["wq"].dtype == jnp.int8
+    assert "lm_head_q" in qp                      # tied → transposed copy
+
+    tokens = np.arange(1, 17, dtype=np.int32)[None]
+    lf = _logits(cfg, params, tokens)
+    lq = _logits(cfg, qp, tokens)
+    cos = np.sum(lf * lq) / (np.linalg.norm(lf) * np.linalg.norm(lq))
+    assert cos > 0.999, cos
+    rel = np.linalg.norm(lq - lf) / np.linalg.norm(lf)
+    assert rel < 0.05, rel
+
+
+def test_quantized_v1_engine_generates(devices):
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    build_mesh(data=8)
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    eng = InferenceEngineTPU(cfg, {"dtype": "float32",
+                                   "weight_quant": "int8",
+                                   "max_out_tokens": 32},
+                             rng=jax.random.PRNGKey(0))
+    out = eng.generate(np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0),
+                       max_new_tokens=6, temperature=0.0)
+    assert out.shape == (2, 14)
+    assert (np.asarray(out) >= 0).all() and \
+        (np.asarray(out) < cfg.vocab_size).all()
+
+
+def test_quantized_ragged_engine_generates(devices):
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    build_mesh(data=8)
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    eng = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "weight_quant": "int8",
+              "num_blocks": 64, "block_size": 16, "max_seq_len": 128},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, size=(n,), dtype=np.int32)
+               for n in (9, 17, 5)]
+    outs = eng.generate(prompts, max_new_tokens=6)
+    assert len(outs) == 3
+    for o in outs:
+        assert (np.asarray(o) < 256).all()
+
+
+def test_weight_quant_rejects_tp(devices):
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    build_mesh(data=4, model=2)
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    with pytest.raises(ValueError, match="tp_size=1"):
+        InferenceEngineTPU(cfg, {"dtype": "float32",
+                                 "weight_quant": "int8",
+                                 "tensor_parallel": {"tp_size": 2}},
+                           rng=jax.random.PRNGKey(0))
+
+
+def test_weight_quant_rejects_moe(devices):
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models import transformer
+    cfg = mixtral_config("tiny")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="MoE"):
+        quantize_param_tree(params)
+
+
+def test_weight_quant_invalid_mode_fails_fast(devices):
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    build_mesh(data=8)
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    with pytest.raises(ValueError, match="only 'int8'"):
+        InferenceEngineTPU(cfg, {"weight_quant": "int4"})
+    with pytest.raises(ValueError, match="only 'int8'"):
+        RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp8"})
