@@ -84,9 +84,6 @@ class InferenceEngineTPU:
         self.config = config
         from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
         validate_weight_quant(config.weight_quant)
-        if config.weight_quant and config.tp_size > 1:
-            raise ValueError("weight_quant=int8 requires tp_size=1 "
-                             "(quantized leaves are not TP-sharded)")
         if mesh is not None:
             self.mesh = mesh
         elif has_mesh():
@@ -97,6 +94,10 @@ class InferenceEngineTPU:
                       "float16": jnp.float16}[config.dtype]
 
         tp = self.mesh.shape["model"] > 1
+        if config.weight_quant and tp:
+            raise ValueError("weight_quant=int8 requires tp_size=1 / a "
+                             "mesh with model axis 1 (quantized leaves "
+                             "are not TP-sharded)")
         specs = partition_specs(model, zero_stage=0, tp=tp)
         self._param_sh = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
@@ -139,7 +140,7 @@ class InferenceEngineTPU:
                 moe_layer, top_k=model.num_experts_per_tok,
                 drop_tokens=False, aux_loss_coef=0.0,
                 ep_axis="expert" if self.mesh.shape["expert"] > 1
-                else None)
+                else None, norm_topk=model.norm_topk_prob)
         self._step = jax.jit(
             partial(forward_with_cache, model, moe_fn=self._moe_fn),
             donate_argnums=(2,))

@@ -210,7 +210,8 @@ class RaggedInferenceEngineTPU:
             from deepspeed_tpu.parallel.moe import moe_layer
             from functools import partial as _p
             moe_fn = _p(moe_layer, top_k=model.num_experts_per_tok,
-                        drop_tokens=False, aux_loss_coef=0.0, ep_axis=None)
+                        drop_tokens=False, aux_loss_coef=0.0, ep_axis=None,
+                        norm_topk=model.norm_topk_prob)
         self._moe_fn = moe_fn
         #: jit cache keyed on (n_bucket, c_bucket, mode) — the step takes
         #: ONE packed int32 vector (tokens|counts|starts|page_table): four

@@ -30,9 +30,9 @@ Params = Any
 # config mapping
 # ---------------------------------------------------------------------------
 
-_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "gpt_neox",
-              "gemma", "gpt2", "opt", "bloom", "falcon", "phi",
-              "gpt_bigcode")
+_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
+              "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
+              "phi", "gpt_bigcode")
 
 
 def _map_hf_act(act: str) -> str:
@@ -194,19 +194,35 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
         pos_emb="rope",
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
-        use_bias=(mt == "qwen2"),   # qwen2: qkv bias only; handled in map
+        use_bias=(mt in ("qwen2", "qwen2_moe")),   # qkv bias only
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
     # HF semantics differ per family: Mistral applies sliding_window
     # whenever set; Qwen2 gates it behind use_sliding_window=False BY
     # DEFAULT
-    use_swa_default = mt != "qwen2"
+    use_swa_default = mt not in ("qwen2", "qwen2_moe")
     if hf.get("sliding_window") and hf.get("use_sliding_window",
                                            use_swa_default):
         kw["sliding_window"] = int(hf["sliding_window"])
     if mt == "mixtral":
         kw.update(num_experts=hf["num_local_experts"],
                   num_experts_per_tok=hf.get("num_experts_per_tok", 2))
+    if mt == "qwen2_moe":
+        if hf.get("decoder_sparse_step", 1) != 1 or \
+                hf.get("mlp_only_layers"):
+            raise ValueError(
+                "qwen2_moe with interleaved dense layers "
+                "(decoder_sparse_step != 1 / mlp_only_layers) is not "
+                "supported — the stacked-layer scan needs uniform blocks")
+        kw.update(
+            num_experts=hf["num_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 4),
+            norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            # experts use moe_intermediate_size; the config's dense
+            # intermediate_size only applies to mlp_only layers (none)
+            intermediate_size=hf["moe_intermediate_size"],
+            shared_expert_size=hf["shared_expert_intermediate_size"],
+            shared_expert_gate=True)
     if mt == "gemma":
         # gemma stores RMSNorm as (1 + w) — folded into `scale` at load —
         # plus GeGLU, sqrt(d)-scaled embeddings and a decoupled head_dim
@@ -380,6 +396,8 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
     if _is_gemma_layout(cfg):
         mt = "gemma"
         arch = ["GemmaForCausalLM"]
+    elif cfg.num_experts and cfg.shared_expert_size:
+        mt, arch = "qwen2_moe", ["Qwen2MoeForCausalLM"]
     elif cfg.num_experts:
         mt, arch = "mixtral", ["MixtralForCausalLM"]
     elif cfg.use_bias:
@@ -421,7 +439,15 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             hf["final_logit_softcapping"] = cfg.logit_softcap
     elif cfg.head_dim_override is not None:
         hf["head_dim"] = cfg.head_dim_override
-    if cfg.num_experts:
+    if cfg.num_experts and cfg.shared_expert_size:   # qwen2_moe
+        hf.update(num_experts=cfg.num_experts,
+                  num_experts_per_tok=cfg.num_experts_per_tok,
+                  moe_intermediate_size=cfg.ffn_size,
+                  intermediate_size=cfg.ffn_size,
+                  shared_expert_intermediate_size=cfg.shared_expert_size,
+                  norm_topk_prob=cfg.norm_topk_prob,
+                  decoder_sparse_step=1, mlp_only_layers=[])
+    elif cfg.num_experts:
         hf["num_local_experts"] = cfg.num_experts
         hf["num_experts_per_tok"] = cfg.num_experts_per_tok
     return hf
@@ -512,18 +538,34 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
     }
     if cfg.num_experts:
         E = cfg.num_experts
-        ep = p + "block_sparse_moe.experts.{}."
+        is_qwen_moe = hf_cfg.get("model_type") == "qwen2_moe"
+        ep = p + ("mlp.experts.{}." if is_qwen_moe
+                  else "block_sparse_moe.experts.{}.")
 
         def estackT(suffix):
             return np.stack([
                 np.stack([T(ep.format(i, e) + suffix) for e in range(E)])
                 for i in range(L)])
-        layers["moe"] = {
-            "router": stackT(p + "block_sparse_moe.gate.weight"),
-            "wg": estackT("w1.weight"),       # mixtral w1 = gate
-            "wo": estackT("w2.weight"),       # w2 = down
-            "wi": estackT("w3.weight"),       # w3 = up
-        }
+        if is_qwen_moe:
+            layers["moe"] = {
+                "router": stackT(p + "mlp.gate.weight"),
+                "wg": estackT("gate_proj.weight"),
+                "wi": estackT("up_proj.weight"),
+                "wo": estackT("down_proj.weight"),
+                "shared": {
+                    "wg": stackT(p + "mlp.shared_expert.gate_proj.weight"),
+                    "wi": stackT(p + "mlp.shared_expert.up_proj.weight"),
+                    "wo": stackT(p + "mlp.shared_expert.down_proj.weight"),
+                    "gate": stackT(p + "mlp.shared_expert_gate.weight"),
+                },
+            }
+        else:
+            layers["moe"] = {
+                "router": stackT(p + "block_sparse_moe.gate.weight"),
+                "wg": estackT("w1.weight"),       # mixtral w1 = gate
+                "wo": estackT("w2.weight"),       # w2 = down
+                "wi": estackT("w3.weight"),       # w3 = up
+            }
     else:
         layers["mlp"] = {
             "wg": stackT(p + "mlp.gate_proj.weight"),
@@ -997,7 +1039,26 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
         out[p.format(i) + "input_layernorm.weight"] = lyr["ln1"]["scale"][i]
         out[p.format(i) + "post_attention_layernorm.weight"] = \
             lyr["ln2"]["scale"][i]
-        if cfg.num_experts:
+        if cfg.num_experts and cfg.shared_expert_size:   # qwen2_moe
+            moe = lyr["moe"]
+            out[p.format(i) + "mlp.gate.weight"] = \
+                np.ascontiguousarray(moe["router"][i].T)
+            for e in range(cfg.num_experts):
+                ep = p.format(i) + f"mlp.experts.{e}."
+                out[ep + "gate_proj.weight"] = \
+                    np.ascontiguousarray(moe["wg"][i, e].T)
+                out[ep + "up_proj.weight"] = \
+                    np.ascontiguousarray(moe["wi"][i, e].T)
+                out[ep + "down_proj.weight"] = \
+                    np.ascontiguousarray(moe["wo"][i, e].T)
+            sh = moe["shared"]
+            sp = p.format(i) + "mlp.shared_expert."
+            out[sp + "gate_proj.weight"] = np.ascontiguousarray(sh["wg"][i].T)
+            out[sp + "up_proj.weight"] = np.ascontiguousarray(sh["wi"][i].T)
+            out[sp + "down_proj.weight"] = np.ascontiguousarray(sh["wo"][i].T)
+            out[p.format(i) + "mlp.shared_expert_gate.weight"] = \
+                np.ascontiguousarray(sh["gate"][i].T)
+        elif cfg.num_experts:
             moe = lyr["moe"]
             out[p.format(i) + "block_sparse_moe.gate.weight"] = \
                 np.ascontiguousarray(moe["router"][i].T)

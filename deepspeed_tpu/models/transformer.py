@@ -69,6 +69,15 @@ class DecoderConfig:
     # MoE (used by mixtral preset; dense when num_experts == 0)
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    #: normalize the selected top-k routing probs (Mixtral True;
+    #: Qwen2-MoE ships norm_topk_prob False — raw softmax values)
+    norm_topk_prob: bool = True
+    #: Qwen2-MoE/DeepSeek shared expert: a dense MLP of this
+    #: intermediate size runs on EVERY token alongside the routed
+    #: experts (0 = none)
+    shared_expert_size: int = 0
+    #: sigmoid(x @ gate) scaling on the shared expert output (Qwen2-MoE)
+    shared_expert_gate: bool = False
     # initializer
     init_std: float = 0.02
     #: decoupled head dim (Gemma head_dim=256 with H*Dh != hidden);
@@ -142,6 +151,9 @@ class DecoderConfig:
             mlp = 2 * d * h
         if self.num_experts:
             mlp = mlp * self.num_experts + d * self.num_experts  # + router
+            if self.shared_expert_size:
+                mlp += 3 * d * self.shared_expert_size \
+                    + (d if self.shared_expert_gate else 0)
         per_layer = attn + mlp + 2 * d
         emb = v * d + (self.max_seq_len * d if self.pos_emb == "learned"
                        else 0)
@@ -458,7 +470,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     h = cfg.ffn_size
     kd = cfg.kv_heads * cfg.head_dim
     qd = cfg.q_dim
-    keys = jax.random.split(rng, 12)
+    keys = jax.random.split(rng, 16)
 
     def w(key, shape, std=cfg.init_std):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
@@ -487,6 +499,17 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
             "wi": w(keys[6], (L, E, d, h)),
             "wo": w(keys[7], (L, E, h, d), std=cfg.init_std / math.sqrt(2 * L)),
         }
+        if cfg.shared_expert_size:
+            hs = cfg.shared_expert_size
+            shared = {
+                "wg": w(keys[12], (L, d, hs)),
+                "wi": w(keys[13], (L, d, hs)),
+                "wo": w(keys[14], (L, hs, d),
+                        std=cfg.init_std / math.sqrt(2 * L)),
+            }
+            if cfg.shared_expert_gate:
+                shared["gate"] = w(keys[15], (L, d, 1))
+            layers["moe"]["shared"] = shared
     else:
         if cfg.is_glu:
             layers["mlp"] = {
@@ -571,23 +594,19 @@ def _softcap(cfg: DecoderConfig, logits: jax.Array) -> jax.Array:
 
 def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
     """Final projection: hidden [B,T,D] → logits [B,T,V] fp32."""
-    if "lm_head_q" in params:   # int8 logits copy (tied models, serving)
+    q_name = "lm_head_q" if "lm_head_q" in params else \
+        ("lm_head" if "lm_head_scale" in params else None)
+    if q_name:   # int8 serving head (tied models carry a transposed copy)
         from deepspeed_tpu.ops.quantized_linear import qmatmul
         b, t, d = x.shape
-        logits = qmatmul(x.reshape(b * t, d), params["lm_head_q"],
-                         params["lm_head_q_scale"],
-                         out_dtype=jnp.float32).reshape(b, t, -1)
-    elif cfg.tie_embeddings:
-        logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
-                            preferred_element_type=jnp.float32)
-    elif "lm_head_scale" in params:
-        from deepspeed_tpu.ops.quantized_linear import qmatmul
-        b, t, d = x.shape
-        logits = qmatmul(x.reshape(b * t, d), params["lm_head"],
-                         params["lm_head_scale"],
+        logits = qmatmul(x.reshape(b * t, d), params[q_name],
+                         params[q_name + "_scale"],
                          out_dtype=jnp.float32).reshape(b, t, -1)
         if "lm_head_bias" in params:
             logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    elif cfg.tie_embeddings:
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
+                            preferred_element_type=jnp.float32)
     else:
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                             preferred_element_type=jnp.float32)
@@ -872,6 +891,17 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
             "wi": spec(None, "expert", efsdp, model),
             "wo": spec(None, "expert", model, efsdp),
         }
+        if cfg.shared_expert_size:
+            # shared expert is DENSE (runs on every token): sharded like
+            # a dense MLP, replicated over 'expert'
+            shared = {
+                "wg": spec(None, fsdp, model),
+                "wi": spec(None, fsdp, model),
+                "wo": spec(None, model, fsdp),
+            }
+            if cfg.shared_expert_gate:
+                shared["gate"] = spec(None, fsdp, None)
+            layers["moe"]["shared"] = shared
     else:
         mlp = {
             "wi": spec(None, fsdp, model),

@@ -37,7 +37,8 @@ def _capacity(num_tokens: int, num_experts: int, k: int,
     return max(cap, min_capacity)
 
 
-def topk_gating(logits: jax.Array, k: int, capacity: int
+def topk_gating(logits: jax.Array, k: int, capacity: int,
+                norm_probs: bool = True
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k gating with capacity (reference topkgating:374).
 
@@ -46,12 +47,14 @@ def topk_gating(logits: jax.Array, k: int, capacity: int
     ``capacity`` are dropped; callers wanting the reference's
     ``drop_tokens=False`` semantics pass ``capacity == S`` (static worst
     case — the TPU answer to the reference's dynamic capacity raise).
+    ``norm_probs``: renormalize the selected gate values (Mixtral); off
+    for Qwen2-MoE's norm_topk_prob=False raw-softmax convention.
     """
     s, e = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)                   # [S,E]
     topv, topi = lax.top_k(gates, k)                          # [S,k]
-    # normalize the selected gate values (reference topkgating norm)
-    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    if norm_probs:   # reference topkgating norm
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
 
     # aux loss from the top-1 assignment (reference top1gating:262)
     mask1 = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
@@ -84,11 +87,14 @@ def moe_layer(cfg, p, x: jax.Array,
               min_capacity: int = 4,
               drop_tokens: bool = True,
               aux_loss_coef: float = 0.01,
-              ep_axis: Optional[str] = "expert"
+              ep_axis: Optional[str] = "expert",
+              norm_topk: bool = True
               ) -> Tuple[jax.Array, jax.Array]:
     """The ``moe_fn`` consumed by models.transformer.decoder_block.
 
-    p: {"router": [d,E], "wg": [E,d,h], "wi": [E,d,h], "wo": [E,h,d]}
+    p: {"router": [d,E], "wg": [E,d,h], "wi": [E,d,h], "wo": [E,h,d]},
+    plus optionally "shared" {wg/wi/wo [d,hs]/[hs,d], gate [d,1]} — the
+    Qwen2-MoE/DeepSeek shared expert that runs densely on every token.
     x: [B,T,d] → (out [B,T,d], scaled aux loss).
     """
     b, t, d = x.shape
@@ -102,7 +108,8 @@ def moe_layer(cfg, p, x: jax.Array,
     # under jit, so we provision for S)
     cap = _capacity(s, e, top_k, capacity_factor, min_capacity) \
         if drop_tokens else s
-    dispatch, combine, aux = topk_gating(logits, top_k, cap)
+    dispatch, combine, aux = topk_gating(logits, top_k, cap,
+                                         norm_probs=norm_topk)
 
     ep_mesh = None
     if ep_axis is not None:
@@ -131,4 +138,16 @@ def moe_layer(cfg, p, x: jax.Array,
             out_buf, NamedSharding(ep_mesh, P(ep_axis, None, None)))
 
     out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_buf)
+
+    if "shared" in p:   # Qwen2-MoE/DeepSeek: dense expert on every token
+        sh = p["shared"]
+        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
+        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
+        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
+                           sh["wo"])
+        if "gate" in sh:
+            s_out = s_out * jax.nn.sigmoid(
+                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
+                           sh["gate"].astype(jnp.float32))).astype(x.dtype)
+        out = out + s_out
     return out.reshape(b, t, d), aux * aux_loss_coef

@@ -127,7 +127,8 @@ def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):
                    min_capacity=ds_cfg.moe.min_capacity,
                    drop_tokens=ds_cfg.moe.drop_tokens,
                    aux_loss_coef=ds_cfg.moe.aux_loss_coef,
-                   ep_axis="expert" if ds_cfg.moe.ep_size > 1 else None)
+                   ep_axis="expert" if ds_cfg.moe.ep_size > 1 else None,
+                   norm_topk=dec_cfg.norm_topk_prob)
 
 
 def decoder_model_spec(dec_cfg: DecoderConfig,

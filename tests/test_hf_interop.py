@@ -562,3 +562,93 @@ def test_gqa_learned_pos_export_rejected(tmp_path):
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises((ValueError, NotImplementedError)):
         export_hf_checkpoint(cfg, params, str(tmp_path / "nope"))
+
+
+def _qwen2_moe_parity(hf_model, model_dir, rtol=5e-3, atol=5e-3):
+    from functools import partial
+    from deepspeed_tpu.parallel.moe import moe_layer
+    cfg, params = load_hf_checkpoint(model_dir)
+    moe_fn = partial(moe_layer, top_k=cfg.num_experts_per_tok,
+                     capacity_factor=8.0, drop_tokens=False,
+                     aux_loss_coef=0.0, ep_axis=None,
+                     norm_topk=cfg.norm_topk_prob)
+    tokens = np.arange(1, 13, dtype=np.int32)[None]
+    params = jax.tree.map(jnp.asarray, params)
+    hidden, _aux = transformer.forward_hidden(cfg, params,
+                                              jnp.asarray(tokens),
+                                              moe_fn=moe_fn)
+    ours = np.asarray(transformer.lm_logits(cfg, params, hidden))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens.astype(np.int64))
+                          ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=rtol, atol=atol)
+    return cfg
+
+
+def test_qwen2_moe_logits_parity(tmp_path):
+    """Qwen2-MoE: shared expert with sigmoid gate, raw-softmax routing
+    (norm_topk_prob=False), qwen2-style qkv biases."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    cfg = Qwen2MoeConfig(hidden_size=64, intermediate_size=96,
+                         moe_intermediate_size=96,
+                         shared_expert_intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, vocab_size=256,
+                         max_position_embeddings=128,
+                         norm_topk_prob=False, tie_word_embeddings=False)
+    torch.manual_seed(20)
+    model = Qwen2MoeForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_qwen2moe")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _qwen2_moe_parity(model, d)
+    assert got.shared_expert_size == 128 and got.shared_expert_gate
+    assert not got.norm_topk_prob and got.use_bias
+
+
+def test_qwen2_moe_norm_topk_variant(tmp_path):
+    """norm_topk_prob=True must flow through to the gating."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    cfg = Qwen2MoeConfig(hidden_size=64, intermediate_size=96,
+                         moe_intermediate_size=96,
+                         shared_expert_intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, vocab_size=256,
+                         max_position_embeddings=128,
+                         norm_topk_prob=True, tie_word_embeddings=False)
+    torch.manual_seed(21)
+    model = Qwen2MoeForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_qwen2moe_norm")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _qwen2_moe_parity(model, d)
+    assert got.norm_topk_prob
+
+
+def test_qwen2_moe_export_roundtrip(tmp_path):
+    from deepspeed_tpu.models.qwen2_moe import qwen2_moe_config
+    from transformers import Qwen2MoeForCausalLM
+    cfg = qwen2_moe_config("tiny", vocab_size=256, max_seq_len=128)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(22))
+    out = str(tmp_path / "export_qwen2moe")
+    export_hf_checkpoint(cfg, params, out)
+    with open(os.path.join(out, "config.json")) as fh:
+        hf_cfg = json.load(fh)
+    assert hf_cfg["model_type"] == "qwen2_moe"
+    hf = Qwen2MoeForCausalLM.from_pretrained(out).eval()
+    # reload OUR export through OUR loader too (full roundtrip)
+    cfg2, params2 = load_hf_checkpoint(out)
+    assert cfg2.shared_expert_size == cfg.shared_expert_size
+    _qwen2_moe_parity(hf, out)
+
+
+def test_qwen2_moe_rejects_interleaved_dense(tmp_path):
+    from deepspeed_tpu.models.hf_loader import config_from_hf
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        config_from_hf({"model_type": "qwen2_moe", "hidden_size": 64,
+                        "num_hidden_layers": 4, "num_attention_heads": 4,
+                        "moe_intermediate_size": 96,
+                        "shared_expert_intermediate_size": 128,
+                        "num_experts": 4, "vocab_size": 256,
+                        "intermediate_size": 96,
+                        "decoder_sparse_step": 2})

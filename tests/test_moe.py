@@ -92,3 +92,33 @@ def test_mixtral_end_to_end_training(devices):
     assert all(np.isfinite(ep4)) and ep4[-1] < ep4[0]
     ep1 = run(dict(data=8), 1)
     np.testing.assert_allclose(ep4, ep1, rtol=1e-3, atol=1e-3)
+
+
+def test_shared_expert_moe_trains_and_matches_ep1(devices):
+    """Qwen2-MoE-style shared expert: engine training runs, and EP=4
+    matches EP=1 losses (the shared expert is dense/replicated; only the
+    routed experts shard over 'expert')."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.qwen2_moe import qwen2_moe_config
+
+    model = qwen2_moe_config("tiny", max_seq_len=64, vocab_size=256)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32), dtype=np.int32)}
+
+    def losses(ep):
+        build_mesh(data=8 // ep, expert=ep)
+        engine, *_ = ds.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+                    "moe": {"enabled": True, "ep_size": ep,
+                            "num_experts": model.num_experts,
+                            "capacity_factor": 4.0},
+                    "steps_per_print": 1000},
+            rng=jax.random.PRNGKey(0))
+        return [float(engine.train_batch(iter([batch]))) for _ in range(4)]
+
+    l1 = losses(1)
+    l4 = losses(4)
+    assert l1[-1] < l1[0]
+    np.testing.assert_allclose(l1, l4, rtol=2e-4)
