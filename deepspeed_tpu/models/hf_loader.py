@@ -32,7 +32,7 @@ Params = Any
 
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
               "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
-              "phi", "gpt_bigcode")
+              "phi", "phi3", "gpt_bigcode")
 
 
 def _map_hf_act(act: str) -> str:
@@ -200,6 +200,11 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
     # HF semantics differ per family: Mistral applies sliding_window
     # whenever set; Qwen2 gates it behind use_sliding_window=False BY
     # DEFAULT
+    if mt == "phi3":
+        if hf.get("rope_scaling"):
+            raise ValueError("phi3 rope_scaling (longrope) is not "
+                             "supported; use the base-context variant")
+        kw["rotary_pct"] = float(hf.get("partial_rotary_factor", 1.0))
     use_swa_default = mt not in ("qwen2", "qwen2_moe")
     if hf.get("sliding_window") and hf.get("use_sliding_window",
                                            use_swa_default):
@@ -507,6 +512,8 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         return cfg, _load_falcon(cfg, hf_cfg, get, names, dtype)
     if mt == "phi":
         return cfg, _load_phi(cfg, get, dtype)
+    if mt == "phi3":
+        return cfg, _load_phi3(cfg, get, names, dtype)
 
     def T(name):
         return np.ascontiguousarray(get(name).astype(dtype).T)
@@ -950,6 +957,47 @@ def _load_falcon(cfg: DecoderConfig, hf_cfg, get, names, dtype) -> Params:
         "final_norm": {"scale": get("transformer.ln_f.weight").astype(dtype),
                        "bias": get("transformer.ln_f.bias").astype(dtype)},
     }, cfg, get, names, dtype)
+
+
+def _load_phi3(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """Phi-3 layout: llama-family math with FUSED qkv_proj ([q|k|v] on
+    the out dim) and FUSED gate_up_proj ([gate|up]); no biases."""
+    L, D = cfg.num_layers, cfg.hidden_size
+    qd = cfg.q_dim
+    kvd = cfg.kv_heads * cfg.head_dim
+    h = cfg.ffn_size
+    p = "model.layers.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+
+    def split_qkv(i):
+        # transposed VIEW; np.stack below makes the one contiguous copy
+        w = get(p.format(i) + "self_attn.qkv_proj.weight").astype(dtype).T
+        return w[:, :qd], w[:, qd:qd + kvd], w[:, qd + kvd:]
+
+    def split_gate_up(i):
+        w = get(p.format(i) + "mlp.gate_up_proj.weight").astype(dtype).T
+        return w[:, :h], w[:, h:]
+
+    qw, kw_, vw = zip(*(split_qkv(i) for i in range(L)))
+    gw, uw = zip(*(split_gate_up(i) for i in range(L)))
+    layers = {
+        "attn": {
+            "wq": np.stack(qw), "wk": np.stack(kw_), "wv": np.stack(vw),
+            "wo": stackT(p + "self_attn.o_proj.weight"),
+        },
+        "ln1": {"scale": stack(p + "input_layernorm.weight")},
+        "ln2": {"scale": stack(p + "post_attention_layernorm.weight")},
+        "mlp": {
+            "wg": np.stack(gw), "wi": np.stack(uw),
+            "wo": stackT(p + "mlp.down_proj.weight"),
+        },
+    }
+    params: Params = {
+        "embed": {"tokens": get("model.embed_tokens.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {"scale": get("model.norm.weight").astype(dtype)},
+    }
+    return _attach_untied_head(params, cfg, get, names, dtype)
 
 
 def _load_phi(cfg: DecoderConfig, get, dtype) -> Params:

@@ -4,9 +4,9 @@ export back to HF format.
     python examples/finetune_hf.py --model-dir /path/to/hf_llama \
         --steps 10 --export-dir /tmp/finetuned_hf
 
-Load + --export-dir re-export work for all 13 in-tree families (Llama/
+Load + --export-dir re-export work for all 14 in-tree families (Llama/
 Mistral/Mixtral/Qwen2/Qwen2-MoE/GPT-NeoX/Gemma/GPT-2/OPT/BLOOM/
-Falcon/Phi/GPT-BigCode)
+Falcon/Phi/Phi-3/GPT-BigCode)
 (models/hf_loader.py maps names both directions; logits parity is tested
 in tests/test_hf_interop.py).
 """

@@ -652,3 +652,44 @@ def test_qwen2_moe_rejects_interleaved_dense(tmp_path):
                         "num_experts": 4, "vocab_size": 256,
                         "intermediate_size": 96,
                         "decoder_sparse_step": 2})
+
+
+def test_phi3_logits_parity(tmp_path):
+    """Phi-3: llama-family math with fused qkv_proj and gate_up_proj.
+    Re-export lands on the equivalent 'llama' layout (same math)."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+    cfg = Phi3Config(hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, vocab_size=256,
+                     max_position_embeddings=128, pad_token_id=0,
+                     tie_word_embeddings=False)
+    torch.manual_seed(23)
+    model = Phi3ForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_phi3")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.norm == "rmsnorm" and not got.use_bias
+    # real roundtrip through the llama-equivalent export
+    dcfg, params = load_hf_checkpoint(d)
+    out = str(tmp_path / "export_phi3")
+    export_hf_checkpoint(dcfg, jax.tree.map(jnp.asarray, params), out)
+    with open(os.path.join(out, "config.json")) as fh:
+        assert json.load(fh)["model_type"] == "llama"
+    from transformers import AutoModelForCausalLM
+    re_model = AutoModelForCausalLM.from_pretrained(out).eval()
+    tokens = np.arange(1, 13, dtype=np.int32)[None]
+    ours = np.asarray(transformer.forward(
+        dcfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = re_model(torch.tensor(tokens.astype(np.int64))
+                          ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_phi3_rejects_longrope(tmp_path):
+    from deepspeed_tpu.models.hf_loader import config_from_hf
+    with pytest.raises(ValueError, match="longrope"):
+        config_from_hf({"model_type": "phi3", "hidden_size": 64,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "intermediate_size": 128, "vocab_size": 256,
+                        "rope_scaling": {"type": "longrope"}})
