@@ -82,7 +82,7 @@ def main() -> None:
     v2 = RaggedInferenceEngineTPU(
         model, {"dtype": dtype, "num_blocks": 512, "block_size": 64,
                 "max_seq_len": seq_cap, "prefill_chunk": 512,
-                "max_batch_tokens": 4096, "weight_quant": wq,
+                "max_batch_tokens": 8192, "weight_quant": wq,
                 "use_pallas": (False if args.no_pallas else None)},
         params=None if args.quant else v1.params,
         rng=jax.random.PRNGKey(0))

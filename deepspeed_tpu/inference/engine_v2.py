@@ -54,13 +54,20 @@ class RaggedInferenceConfig(TPUConfigModel):
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
                    counts: jax.Array, starts: jax.Array,
                    page_table: jax.Array, use_pallas: bool = False,
-                   moe_fn=None):
+                   moe_fn=None, fresh_prefill: bool = False):
     """One forward over a ragged batch against the paged KV arena.
 
     tokens: [n, c] (row i valid for j < counts[i]); starts: [n] tokens
     already cached; page_table: [n, mb]. Returns (last-token logits [n, V]
     fp32, updated arena). Rows with counts == 0 produce garbage logits the
     caller ignores.
+
+    ``fresh_prefill``: STATIC promise that every row has starts == 0 (a
+    first prompt chunk). Attention then runs causally WITHIN the chunk
+    and never reads the arena — the KV write still lands for later
+    decode, but without the per-layer write→read dependency on the
+    ~GB arena, which XLA otherwise serializes (measured 395 → ~200 ms
+    on a 16x512 prefill step, v5e 1.27B).
     """
     if cfg.pos_emb == "alibi":
         # the paged kernels have no score-bias port; serving BLOOM-class
@@ -99,7 +106,19 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
         q, k, v = qkv_project(cfg, lp["attn"], h_in, sin, cos)
         ak, av = pa.write_kv(ak, av, k, v, pt_l, starts, counts,
                              trash_block=off + stride - 1)
-        out = attend(q, ak, av, pt_l, starts, counts)
+        if fresh_prefill:
+            # starts == 0 everywhere: the chunk IS the whole history —
+            # plain causal attention over it; padded-tail rows produce
+            # garbage outputs nothing reads (their KV went to trash)
+            if use_pallas:
+                from deepspeed_tpu.ops.flash_attention import flash_attention
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                from deepspeed_tpu.models.transformer import \
+                    dot_product_attention
+                out = dot_product_attention(q, k, v, causal=True)
+        else:
+            out = attend(q, ak, av, pt_l, starts, counts)
         attn_out = attn_out_project(cfg, lp["attn"], out)
         h_out, _aux = block_combine(cfg, lp, x, h_in, attn_out, moe_fn)
         return (h_out, ak, av), None
@@ -213,7 +232,10 @@ class RaggedInferenceEngineTPU:
                         drop_tokens=False, aux_loss_coef=0.0, ep_axis=None,
                         norm_topk=model.norm_topk_prob)
         self._moe_fn = moe_fn
-        #: jit cache keyed on (n_bucket, c_bucket, mode) — the step takes
+        #: jit cache keyed on (n_bucket, c_bucket, mode, fresh) — the
+        #: fresh=True/False split legitimately doubles prefill-shape
+        #: compiles (arena-reading vs within-chunk attention programs).
+        #: The step takes
         #: ONE packed int32 vector (tokens|counts|starts|page_table): four
         #: separate small host→device uploads per decode step each pay a
         #: full dispatch round-trip on remote runtimes (measured 1.5 s vs
@@ -228,7 +250,7 @@ class RaggedInferenceEngineTPU:
                  f"{config.block_size} pallas={self.use_pallas} "
                  f"dtype={config.dtype}")
 
-    def _step_fn(self, nb: int, cb: int, mode):
+    def _step_fn(self, nb: int, cb: int, mode, fresh: bool = False):
         """mode: None → raw logits; ("argmax",) → greedy token ids;
         ("sample", top_k, use_top_p) → sampled token ids. Token modes
         fetch [n] int32 instead of the [n, V] fp32 logits (8 MB per step
@@ -237,7 +259,7 @@ class RaggedInferenceEngineTPU:
         DYNAMIC scalars bitcast into the packed vector, so changing them
         per request does NOT recompile the model forward (only top_k and
         the top-p on/off switch are static)."""
-        key = (nb, cb, mode)
+        key = (nb, cb, mode, fresh)
         if key in self._step_fns:
             return self._step_fns[key]
         mb = self.mb
@@ -255,7 +277,8 @@ class RaggedInferenceEngineTPU:
             off += nb * mb
             logits, arena = ragged_forward(
                 model, params, arena, tokens, counts, starts, pt,
-                use_pallas=self.use_pallas, moe_fn=self._moe_fn)
+                use_pallas=self.use_pallas, moe_fn=self._moe_fn,
+                fresh_prefill=fresh)
             if mode is None:
                 return logits, rng, arena
             temperature = lax.bitcast_convert_type(packed[off],
@@ -387,8 +410,12 @@ class RaggedInferenceEngineTPU:
     def _run(self, batch: RaggedBatch, mode=None) -> np.ndarray:
         n = len(batch.uids)
         nb, cb = self._buckets(batch)
+        # first-chunk-only batches skip the arena READ in attention
+        # (write→read on the ~GB arena serializes the whole layer scan)
+        fresh = cb > 1 and bool((batch.start_positions == 0).all())
         packed = jnp.asarray(self._pack(batch, nb, cb))   # ONE upload
-        out, self._rng_dev, self.arena = self._step_fn(nb, cb, mode)(
+        out, self._rng_dev, self.arena = self._step_fn(nb, cb, mode,
+                                                       fresh)(
             self.params, self.arena, packed, self._rng_dev)
         return np.asarray(jax.device_get(out))[:n]
 
