@@ -54,7 +54,8 @@ class RaggedInferenceConfig(TPUConfigModel):
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
                    counts: jax.Array, starts: jax.Array,
                    page_table: jax.Array, use_pallas: bool = False,
-                   moe_fn=None, fresh_prefill: bool = False):
+                   moe_fn=None,
+                   fresh_prefill: Union[bool, str] = False):
     """One forward over a ragged batch against the paged KV arena.
 
     tokens: [n, c] (row i valid for j < counts[i]); starts: [n] tokens
@@ -62,13 +63,17 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
     fp32, updated arena). Rows with counts == 0 produce garbage logits the
     caller ignores.
 
-    ``fresh_prefill``: STATIC promise that every row has starts == 0 (a
-    first prompt chunk). Attention then runs causally WITHIN the chunk
-    and never reads the arena — the KV write still lands for later
-    decode, but without the per-layer write→read dependency on the
-    ~GB arena, which XLA otherwise serializes (measured 395 → ~200 ms
-    on a 16x512 prefill step, v5e 1.27B).
+    ``fresh_prefill`` (STATIC): False → every chunk attends through the
+    paged arena (the original path). "fresh" → promise that every row
+    has starts == 0: attention runs causally WITHIN the chunk and never
+    reads the arena. "split" → history attends the PRE-write arena and
+    the within-chunk causal part is merged by logsumexp. Both variants
+    remove the per-layer write→read dependency on the ~GB arena, which
+    XLA otherwise serializes (measured 395 → ~200 ms on a 16x512
+    prefill step, v5e 1.27B).
     """
+    if fresh_prefill is True:   # pre-three-mode boolean API
+        fresh_prefill = "fresh"
     if cfg.pos_emb == "alibi":
         # the paged kernels have no score-bias port; serving BLOOM-class
         # models needs the v1 cached engine (forward_with_cache applies
@@ -104,9 +109,18 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
         pt_l = page_table + off       # padded entries → this layer's trash
         h_in = _norm(cfg, lp["ln1"], x)
         q, k, v = qkv_project(cfg, lp["attn"], h_in, sin, cos)
+        split = fresh_prefill == "split" and c > 1
+        if split:
+            # continuation / SplitFuse-mixed chunk: the history part
+            # reads the PRE-write arena — computed BEFORE the write so
+            # no write→read serialization. Fresh rows mixed in have
+            # empty history (lse ≈ -1e30 → weight 0); decode rows ride
+            # along as width-1 chunks.
+            out_h, lse_h = pa.paged_attention_hist_xla(
+                q, ak, av, pt_l, starts)
         ak, av = pa.write_kv(ak, av, k, v, pt_l, starts, counts,
                              trash_block=off + stride - 1)
-        if fresh_prefill:
+        if fresh_prefill == "fresh":
             # starts == 0 everywhere: the chunk IS the whole history —
             # plain causal attention over it; padded-tail rows produce
             # garbage outputs nothing reads (their KV went to trash)
@@ -117,6 +131,16 @@ def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
                 from deepspeed_tpu.models.transformer import \
                     dot_product_attention
                 out = dot_product_attention(q, k, v, causal=True)
+        elif split:
+            if use_pallas:
+                from deepspeed_tpu.ops.flash_attention import \
+                    flash_attention_with_lse
+                out_c, lse_c = flash_attention_with_lse(q, k, v,
+                                                        causal=True)
+            else:
+                out_c, lse_c = pa.causal_attention_with_lse(q, k, v)
+            out = pa.merge_attention(out_h, lse_h, out_c,
+                                     lse_c).astype(q.dtype)
         else:
             out = attend(q, ak, av, pt_l, starts, counts)
         attn_out = attn_out_project(cfg, lp["attn"], out)
@@ -410,9 +434,19 @@ class RaggedInferenceEngineTPU:
     def _run(self, batch: RaggedBatch, mode=None) -> np.ndarray:
         n = len(batch.uids)
         nb, cb = self._buckets(batch)
-        # first-chunk-only batches skip the arena READ in attention
-        # (write→read on the ~GB arena serializes the whole layer scan)
-        fresh = cb > 1 and bool((batch.start_positions == 0).all())
+        # chunk batches avoid the arena READ in attention (the write→read
+        # on the ~GB arena serializes the whole layer scan): first-chunk-
+        # only batches attend within the chunk ("fresh"); continuation /
+        # SplitFuse-mixed batches split history (pre-write arena) +
+        # within-chunk and merge by logsumexp ("split"). Env
+        # DSTPU_NO_SPLIT_PREFILL restores the single paged read (A/B +
+        # escape hatch).
+        if cb == 1 or os.environ.get("DSTPU_NO_SPLIT_PREFILL"):
+            fresh = False
+        elif bool((batch.start_positions == 0).all()):
+            fresh = "fresh"
+        else:
+            fresh = "split"
         packed = jnp.asarray(self._pack(batch, nb, cb))   # ONE upload
         out, self._rng_dev, self.arena = self._step_fn(nb, cb, mode,
                                                        fresh)(

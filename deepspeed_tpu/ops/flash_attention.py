@@ -664,6 +664,28 @@ def _supported(tq, tk, d, block_q, block_k) -> bool:
             tq >= block_q and tk >= block_k and d <= 256)
 
 
+def _pick_blocks(tq, tk, d, itemsize, block_q=None, block_k=None):
+    """Block selection shared by every public wrapper: explicit args, the
+    DSTPU_FLASH_BQ/BK env knobs, per-generation defaults (XL grids want
+    1024/1024 — measured 44.8%% vs 36.0%% MFU at 512/512, seq 16K v5e),
+    then step-down until the shape divides (e.g. tq=768 runs at 256 —
+    far faster than the XLA fallback)."""
+    import os
+    xl = not _resident_ok(tq, tk, d, itemsize)
+    default_bq = 1024 if xl else DEFAULT_BLOCK_Q
+    default_bk = 1024 if xl else DEFAULT_BLOCK_K
+    bq = block_q or int(os.environ.get("DSTPU_FLASH_BQ", 0)) or \
+        min(default_bq, tq)
+    bk = block_k or int(os.environ.get("DSTPU_FLASH_BK", 0)) or \
+        min(default_bk, tk)
+    bq, bk = min(bq, tq), min(bk, tk)
+    while bq > 128 and (tq % bq or not _supported(tq, tk, d, bq, bk)):
+        bq //= 2
+    while bk > 128 and (tk % bk or not _supported(tq, tk, d, bq, bk)):
+        bk //= 2
+    return bq, bk
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     q_offset: int = 0,
@@ -683,25 +705,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     _, tk, kvh, _ = k.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # env knobs for offline block tuning (bench.py sweeps these). The XL
-    # grid amortizes its per-(i,j)-program overhead over bigger tiles:
-    # 1024/1024 measured 44.8% vs 36.0% MFU at 512/512 (seq 16K, v5e)
-    import os
-    xl = not _resident_ok(tq, tk, d, q.dtype.itemsize)
-    default_bq = 1024 if xl else DEFAULT_BLOCK_Q
-    default_bk = 1024 if xl else DEFAULT_BLOCK_K
-    bq = block_q or int(os.environ.get("DSTPU_FLASH_BQ", 0)) or \
-        min(default_bq, tq)
-    bk = block_k or int(os.environ.get("DSTPU_FLASH_BK", 0)) or \
-        min(default_bk, tk)
-    bq, bk = min(bq, tq), min(bk, tk)
-    # step blocks down before abandoning the kernel: e.g. tq=768 doesn't
-    # divide by the 512 default but runs fine (and much faster than the
-    # XLA fallback) at 256
-    while bq > 128 and (tq % bq or not _supported(tq, tk, d, bq, bk)):
-        bq //= 2
-    while bk > 128 and (tk % bk or not _supported(tq, tk, d, bq, bk)):
-        bk //= 2
+    bq, bk = _pick_blocks(tq, tk, d, q.dtype.itemsize, block_q, block_k)
     if not _supported(tq, tk, d, bq, bk) or h % kvh:
         from deepspeed_tpu.models.transformer import dot_product_attention
         from deepspeed_tpu.utils.logging import logger
@@ -717,6 +721,34 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
     out = _flash(qf, kf, vf, causal, q_offset, bq, bk, window, interpret)
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True,
+                             interpret: Optional[bool] = None):
+    """Inference-only flash forward returning (out, lse [B,T,H]) for the
+    paged-history merge (ops/paged_attention.merge_attention). No
+    custom_vjp — serving never differentiates through it. Falls back to
+    the XLA lse-returning reference off-TPU/unsupported shapes."""
+    b, tq, h, d = q.shape
+    _, tk, kvh, _ = k.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq, bk = _pick_blocks(tq, tk, d, q.dtype.itemsize)
+    if not _supported(tq, tk, d, bq, bk) or h % kvh:
+        # NOTE: the lse fallback requires kvh | h (GQA group reshape) —
+        # it raises a clear error otherwise rather than mis-grouping
+        from deepspeed_tpu.ops.paged_attention import \
+            causal_attention_with_lse
+        return causal_attention_with_lse(q, k, v)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
+    out, lse = _fwd(qf, kf, vf, 1.0 / math.sqrt(d), causal, 0, bq, bk,
+                    None, interpret)
+    out = out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, tq).transpose(0, 2, 1)
+    return out, lse
 
 
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -100,6 +100,41 @@ def write_kv(arena_k: jax.Array, arena_v: jax.Array, k: jax.Array,
 # XLA reference path (also the prefill path)
 # ---------------------------------------------------------------------------
 
+def _gather_pages(arena: jax.Array, page_table: jax.Array):
+    """[kvh, nb+1, bs, dh] x [n, mb] → [n, kvh, mb*bs, dh]."""
+    kvh, _, bs, dh = arena.shape
+    n, mb = page_table.shape
+    return arena[:, page_table].transpose(1, 0, 2, 3, 4) \
+        .reshape(n, kvh, mb * bs, dh)
+
+
+def _masked_attention(q: jax.Array, kg: jax.Array, vg: jax.Array,
+                      mask: jax.Array, with_lse: bool):
+    """Shared gathered-softmax core: q [n,c,h,dh], kg/vg [n,kvh,S,dh],
+    mask broadcastable to [n,kvh,g,c,S]. Returns out [n,c,h,dh]
+    (+ lse [n,c,h] fp32 when with_lse)."""
+    n, c, h, dh = q.shape
+    kvh = kg.shape[1]
+    if h % kvh:
+        raise ValueError(f"GQA requires kv heads to divide q heads "
+                         f"(h={h}, kvh={kvh})")
+    groups = h // kvh
+    qg = q.reshape(n, c, kvh, groups, dh)
+    s = jnp.einsum("nckgd,nksd->nkgcs", qg, kg.astype(q.dtype),
+                   preferred_element_type=jnp.float32) / math.sqrt(dh)
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                     # [n,k,g,c]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("nkgcs,nksd->nckgd", p.astype(vg.dtype), vg) \
+        / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    out = out.reshape(n, c, h, dh).astype(q.dtype)
+    if not with_lse:
+        return out
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))                    # [n,k,g,c]
+    return out, lse.transpose(0, 3, 1, 2).reshape(n, c, h)
+
+
 def paged_attention_xla(q: jax.Array, arena_k: jax.Array,
                         arena_v: jax.Array, page_table: jax.Array,
                         starts: jax.Array, counts: jax.Array) -> jax.Array:
@@ -109,29 +144,64 @@ def paged_attention_xla(q: jax.Array, arena_k: jax.Array,
     caller discards them); arena: [kvh, nb+1, bs, dh]; page_table: [n, mb];
     starts/counts: [n]. Returns [n, c, H, dh].
     """
-    kvh, _, bs, dh = arena_k.shape
-    n, c, h, _ = q.shape
-    groups = h // kvh
+    bs = arena_k.shape[2]
+    n, c = q.shape[:2]
     mb = page_table.shape[1]
-
-    # [kvh, n, mb, bs, dh] → [n, kvh, mb*bs, dh]
-    kg = arena_k[:, page_table].transpose(1, 0, 2, 3, 4) \
-        .reshape(n, kvh, mb * bs, dh)
-    vg = arena_v[:, page_table].transpose(1, 0, 2, 3, 4) \
-        .reshape(n, kvh, mb * bs, dh)
-
-    qg = q.reshape(n, c, kvh, groups, dh)
-    s = jnp.einsum("nckgd,nksd->nkgcs", qg, kg.astype(q.dtype),
-                   preferred_element_type=jnp.float32) / math.sqrt(dh)
+    kg = _gather_pages(arena_k, page_table)
+    vg = _gather_pages(arena_v, page_table)
     qpos = starts[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [n, c]
     kpos = jnp.arange(mb * bs, dtype=jnp.int32)                    # [S]
     ctx = starts + counts                                          # [n]
     mask = (kpos[None, None] <= qpos[..., None]) & \
         (kpos[None, None] < ctx[:, None, None])                    # [n, c, S]
-    s = jnp.where(mask[:, None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
-    out = jnp.einsum("nkgcs,nksd->nckgd", p, vg)
-    return out.reshape(n, c, h, dh)
+    return _masked_attention(q, kg, vg, mask[:, None, None], False)
+
+
+def paged_attention_hist_xla(q: jax.Array, arena_k: jax.Array,
+                             arena_v: jax.Array, page_table: jax.Array,
+                             starts: jax.Array):
+    """HISTORY-only attention: row i's queries attend keys [0, starts[i])
+    — the tokens already in the arena BEFORE the current chunk's write.
+    Returns (out [n,c,h,dh], lse [n,c,h] fp32).
+
+    Reading the pre-write arena is what breaks the per-layer write→read
+    dependency XLA otherwise serializes (engine_v2.ragged_forward); the
+    within-chunk causal part is computed separately and merged by
+    logsumexp. Empty-history rows produce lse ≈ -1e30, so their (garbage)
+    out vanishes in the merge — no special-casing for fresh rows mixed
+    into a continuation batch.
+    """
+    bs = arena_k.shape[2]
+    mb = page_table.shape[1]
+    kg = _gather_pages(arena_k, page_table)
+    vg = _gather_pages(arena_v, page_table)
+    kpos = jnp.arange(mb * bs, dtype=jnp.int32)
+    mask = kpos[None, :] < starts[:, None]                      # [n, S]
+    return _masked_attention(q, kg, vg, mask[:, None, None, None, :],
+                             True)
+
+
+def merge_attention(out_a, lse_a, out_b, lse_b):
+    """Combine two attention partials over DISJOINT key sets via their
+    logsumexps (the flash-attention merge): outs [n,c,h,dh], lses
+    [n,c,h] → merged out."""
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = jnp.maximum(wa + wb, 1e-30)[..., None]
+    return (out_a.astype(jnp.float32) * wa[..., None]
+            + out_b.astype(jnp.float32) * wb[..., None]) / denom
+
+
+def causal_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array):
+    """Plain causal attention over one chunk returning (out, lse) for the
+    history merge — XLA path ([n,c,h,dh] layout, GQA via head groups)."""
+    c = q.shape[1]
+    kg = k.transpose(0, 2, 1, 3)                                # [n,kvh,c,d]
+    vg = v.transpose(0, 2, 1, 3)
+    i = jnp.arange(c, dtype=jnp.int32)
+    mask = (i[None, :] <= i[:, None])[None, None, None]
+    return _masked_attention(q, kg, vg, mask, True)
 
 
 # ---------------------------------------------------------------------------

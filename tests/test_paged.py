@@ -335,3 +335,64 @@ def test_stepwise_failure_does_not_leak_pages(devices):
                      eos_token_id=257)
     assert not eng.state.seqs
     assert eng.state.allocator.free_blocks == free_before
+
+
+def test_split_history_merge_matches_paged(devices):
+    """hist(pre-write arena) + within-chunk causal merged by logsumexp
+    must equal the single paged read on a continuation chunk — the
+    equivalence the split-prefill fast path (engine_v2.ragged_forward)
+    rests on. Covers mixed batches: a fresh row (starts=0), a
+    continuation row, and a decode-like row (count=1)."""
+    from deepspeed_tpu.ops.paged_attention import (
+        causal_attention_with_lse, init_arena, merge_attention,
+        paged_attention_hist_xla, paged_attention_xla, write_kv)
+    rng = np.random.default_rng(0)
+    kvh, bs, dh, h, c = 2, 8, 64, 4, 16
+    arena = init_arena(1, kvh, num_blocks=31, block_size=bs, head_dim=dh,
+                       dtype=jnp.float32)
+    ak, av = arena["k"], arena["v"]
+    n, mb = 3, 8
+    pt = jnp.asarray(np.arange(n * mb).reshape(n, mb), jnp.int32)
+    starts = jnp.asarray([0, 24, 40], jnp.int32)
+    counts = jnp.asarray([16, 16, 1], jnp.int32)
+
+    # pre-populate history for rows 1/2
+    hist_k = jnp.asarray(rng.normal(size=(n, 64, kvh, dh)), jnp.float32)
+    hist_v = jnp.asarray(rng.normal(size=(n, 64, kvh, dh)), jnp.float32)
+    ak, av = write_kv(ak, av, hist_k, hist_v, pt,
+                      jnp.zeros((n,), jnp.int32), starts)
+
+    q = jnp.asarray(rng.normal(size=(n, c, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(n, c, kvh, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(n, c, kvh, dh)), jnp.float32)
+
+    # reference: write then one paged read
+    ak2, av2 = write_kv(ak, av, k, v, pt, starts, counts)
+    ref = paged_attention_xla(q, ak2, av2, pt, starts, counts)
+
+    # split: history from the PRE-write arena + within-chunk causal
+    out_h, lse_h = paged_attention_hist_xla(q, ak, av, pt, starts)
+    out_c, lse_c = causal_attention_with_lse(q, k, v)
+    got = merge_attention(out_h, lse_h, out_c, lse_c)
+
+    # compare only valid query rows (j < counts[i])
+    for i in range(n):
+        cc = int(counts[i])
+        np.testing.assert_allclose(np.asarray(got)[i, :cc],
+                                   np.asarray(ref)[i, :cc],
+                                   rtol=2e-5, atol=2e-5, err_msg=f"row {i}")
+
+
+def test_flash_attention_with_lse_matches_xla(devices):
+    from deepspeed_tpu.ops.flash_attention import flash_attention_with_lse
+    from deepspeed_tpu.ops.paged_attention import causal_attention_with_lse
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 256, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 256, 2, 64)), jnp.float32)
+    o1, l1 = flash_attention_with_lse(q, k, v, interpret=True)
+    o2, l2 = causal_attention_with_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=2e-5, atol=2e-5)
