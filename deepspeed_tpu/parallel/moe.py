@@ -14,8 +14,10 @@ expert GEMMs. Capacity is static (jit-friendly); tokens over capacity are
 dropped (``drop_tokens``) or routed best-effort via the mask arithmetic.
 
 Load-balance auxiliary loss per reference top1gating: ``E · Σ_e mē·c̄e``.
-RTS (random token selection, reference :225) is round-2 work — dispatch
-priority is token order, matching the reference's non-RTS path.
+RTS (random token selection, reference :225): with ``use_rts`` the
+capacity-slot priority is a random token permutation per step (keyed
+from the engine's per-step rng), matching the reference's default
+top-1 behavior; off → deterministic sequence-order priority.
 """
 
 import math
@@ -38,7 +40,8 @@ def _capacity(num_tokens: int, num_experts: int, k: int,
 
 
 def topk_gating(logits: jax.Array, k: int, capacity: int,
-                norm_probs: bool = True
+                norm_probs: bool = True,
+                rts_key: Optional[jax.Array] = None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k gating with capacity (reference topkgating:374).
 
@@ -49,6 +52,10 @@ def topk_gating(logits: jax.Array, k: int, capacity: int,
     case — the TPU answer to the reference's dynamic capacity raise).
     ``norm_probs``: renormalize the selected gate values (Mixtral); off
     for Qwen2-MoE's norm_topk_prob=False raw-softmax convention.
+    ``rts_key``: Random Token Selection (reference top1gating:225) —
+    capacity slots are claimed in a RANDOM token order instead of
+    sequence order, so over-capacity drops don't always punish the same
+    trailing tokens. None = deterministic sequence-order priority.
     """
     s, e = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)                   # [S,E]
@@ -62,13 +69,24 @@ def topk_gating(logits: jax.Array, k: int, capacity: int,
     ce = mask1.mean(axis=0)
     aux = jnp.sum(me * ce) * e
 
+    perm = None
+    if rts_key is not None:
+        perm = jax.random.permutation(rts_key, s)
+
     # positions: running per-expert counts across the k choices
     counts = jnp.zeros((e,), jnp.int32)
     dispatch = jnp.zeros((s, e, capacity), jnp.bool_)
     combine = jnp.zeros((s, e, capacity), jnp.float32)
     for i in range(k):
         mask_i = jax.nn.one_hot(topi[:, i], e, dtype=jnp.int32)   # [S,E]
-        pos_i = jnp.cumsum(mask_i, axis=0) - mask_i + counts[None, :]
+        if perm is not None:
+            # claim slots in permuted (random-priority) order, then
+            # scatter the positions back to token order
+            pos_p = jnp.cumsum(mask_i[perm], axis=0) - mask_i[perm] \
+                + counts[None, :]
+            pos_i = jnp.zeros_like(pos_p).at[perm].set(pos_p)
+        else:
+            pos_i = jnp.cumsum(mask_i, axis=0) - mask_i + counts[None, :]
         pos_tok = jnp.sum(pos_i * mask_i, axis=1)                 # [S]
         keep = pos_tok < capacity
         oh_cap = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)
@@ -88,7 +106,8 @@ def moe_layer(cfg, p, x: jax.Array,
               drop_tokens: bool = True,
               aux_loss_coef: float = 0.01,
               ep_axis: Optional[str] = "expert",
-              norm_topk: bool = True
+              norm_topk: bool = True,
+              rts_key: Optional[jax.Array] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """The ``moe_fn`` consumed by models.transformer.decoder_block.
 
@@ -109,7 +128,8 @@ def moe_layer(cfg, p, x: jax.Array,
     cap = _capacity(s, e, top_k, capacity_factor, min_capacity) \
         if drop_tokens else s
     dispatch, combine, aux = topk_gating(logits, top_k, cap,
-                                         norm_probs=norm_topk)
+                                         norm_probs=norm_topk,
+                                         rts_key=rts_key)
 
     ep_mesh = None
     if ep_axis is not None:

@@ -13,6 +13,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from deepspeed_tpu.config import DeepSpeedTPUConfig
 from deepspeed_tpu.models import transformer
@@ -153,6 +154,24 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     def init_fn(rng):
         return transformer.init_params(dec_cfg, rng)
 
+    # RTS (reference top1gating:225 use_rts): random capacity-slot
+    # priority, keyed from the engine's per-step rng — only meaningful
+    # when capacity can drop tokens
+    use_rts = (moe_fn is not None and ds_cfg.moe.use_rts
+               and ds_cfg.moe.drop_tokens)
+
+    def _rts_moe(rng):
+        """Wrap moe_fn with a PER-LAYER rts key: the layer scan traces
+        its body once, so per-layer variation must come from traced
+        layer data — fold the step rng with a bitcast of one router
+        element (distinct across layers; equal values would only make
+        two layers share a permutation, never corrupt routing)."""
+        def mf(c, p, x):
+            lk = jax.random.fold_in(rng, lax.bitcast_convert_type(
+                p["router"].reshape(-1)[0], jnp.int32))
+            return moe_fn(c, p, x, rts_key=lk)
+        return mf
+
     def loss_fn(params, batch, rng):
         tokens = batch["input_ids"]
         if "labels" in batch:
@@ -160,8 +179,9 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         else:
             labels = jnp.concatenate(
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        mf = _rts_moe(rng) if use_rts else moe_fn
         hidden, aux = transformer.forward_hidden(
-            dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=moe_fn,
+            dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
             remat_policy=remat)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
                                                  labels,
@@ -229,7 +249,9 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             tokens = batch["input_ids"]            # [M, B, T]
             return pipelined_loss(dec_cfg, params, tokens,
                                   _pipe_labels(tokens, batch),
-                                  attn_fn=pipe_attn, moe_fn=moe_fn,
+                                  attn_fn=pipe_attn,
+                                  moe_fn=_rts_moe(rng) if use_rts
+                                  else moe_fn,
                                   remat_policy=remat or "full",
                                   num_stages=stages,
                                   ce_budget_bytes=ce_budget,
@@ -240,7 +262,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                 tokens = batch["input_ids"]        # [M, B, T]
                 return pipelined_loss_and_grads_1f1b(
                     dec_cfg, params, tokens, _pipe_labels(tokens, batch),
-                    scale=scale, attn_fn=pipe_attn, moe_fn=moe_fn,
+                    scale=scale, attn_fn=pipe_attn,
+                    moe_fn=_rts_moe(rng) if use_rts else moe_fn,
                     remat_policy=remat or "full", num_stages=stages,
                     ce_budget_bytes=ce_budget, ce_logits_dtype=ce_dtype)
         elif ds_cfg.pipeline.schedule != "gpipe":
