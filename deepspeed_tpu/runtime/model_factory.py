@@ -160,6 +160,11 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     use_rts = (moe_fn is not None and ds_cfg.moe.use_rts
                and ds_cfg.moe.drop_tokens)
 
+    def _moe_for_step(rng):
+        """moe_fn for one step: RTS-wrapped when enabled, raw otherwise
+        (the ONE selection point for all three loss paths)."""
+        return _rts_moe(rng) if use_rts else moe_fn
+
     def _rts_moe(rng):
         """Wrap moe_fn with a PER-LAYER rts key: the layer scan traces
         its body once, so per-layer variation must come from traced
@@ -167,8 +172,10 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         element (distinct across layers; equal values would only make
         two layers share a permutation, never corrupt routing)."""
         def mf(c, p, x):
+            # f32 upcast first: bf16 params bitcast to int16, not int32
             lk = jax.random.fold_in(rng, lax.bitcast_convert_type(
-                p["router"].reshape(-1)[0], jnp.int32))
+                p["router"].reshape(-1)[0].astype(jnp.float32),
+                jnp.int32))
             return moe_fn(c, p, x, rts_key=lk)
         return mf
 
@@ -179,7 +186,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         else:
             labels = jnp.concatenate(
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
-        mf = _rts_moe(rng) if use_rts else moe_fn
+        mf = _moe_for_step(rng)
         hidden, aux = transformer.forward_hidden(
             dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
             remat_policy=remat)
@@ -250,8 +257,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             return pipelined_loss(dec_cfg, params, tokens,
                                   _pipe_labels(tokens, batch),
                                   attn_fn=pipe_attn,
-                                  moe_fn=_rts_moe(rng) if use_rts
-                                  else moe_fn,
+                                  moe_fn=_moe_for_step(rng),
                                   remat_policy=remat or "full",
                                   num_stages=stages,
                                   ce_budget_bytes=ce_budget,
@@ -263,7 +269,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                 return pipelined_loss_and_grads_1f1b(
                     dec_cfg, params, tokens, _pipe_labels(tokens, batch),
                     scale=scale, attn_fn=pipe_attn,
-                    moe_fn=_rts_moe(rng) if use_rts else moe_fn,
+                    moe_fn=_moe_for_step(rng),
                     remat_policy=remat or "full", num_stages=stages,
                     ce_budget_bytes=ce_budget, ce_logits_dtype=ce_dtype)
         elif ds_cfg.pipeline.schedule != "gpipe":

@@ -220,3 +220,37 @@ def test_rts_distinct_keys_per_layer(devices):
     assert seen and all(k is not None for k in seen)
     from jax.core import Tracer
     assert any(isinstance(k, Tracer) for k in seen)
+    # and the derivation itself yields DISTINCT keys/permutations per
+    # layer when evaluated concretely on the real routers
+    routers = np.asarray(params["layers"]["moe"]["router"],
+                         dtype=np.float32)
+    step_key = jax.random.PRNGKey(7)
+    keys = [jax.random.fold_in(step_key, jax.lax.bitcast_convert_type(
+                jnp.float32(r.reshape(-1)[0]), jnp.int32))
+            for r in routers]
+    perms = [np.asarray(jax.random.permutation(k, 16)) for k in keys]
+    assert not np.array_equal(perms[0], perms[1])
+
+
+def test_rts_bf16_params(devices):
+    """Regression: the per-layer RTS key bitcasts a router element — bf16
+    params (16-bit) must upcast before the int32 bitcast (caught by the
+    bf16 multichip dryrun, not the fp32 CPU tests)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    build_mesh(data=8)
+    model = mixtral_config("tiny", max_seq_len=32, vocab_size=128)
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "moe": {"enabled": True, "ep_size": 1, "num_experts": 4,
+                        "capacity_factor": 1.0, "use_rts": True,
+                        "drop_tokens": True},
+                "steps_per_print": 1000},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, (8, 32), dtype=np.int32)}
+    loss = float(engine.train_batch(iter([batch])))
+    assert np.isfinite(loss)
