@@ -1,0 +1,19 @@
+"""Platform-selection workaround shared by CLIs and tools.
+
+The axon TPU tunnel's sitecustomize hook force-registers its plugin and
+programmatically overrides ``JAX_PLATFORMS`` after env processing; jax's
+config knob wins over the hook, so tools that want to honor the user's
+env choice (e.g. ``JAX_PLATFORMS=cpu`` for a virtual-device run) must
+re-assert it before backend init. ``tests/conftest.py`` applies the same
+workaround for the unit suite.
+"""
+
+import os
+
+
+def sync_jax_platform_env() -> None:
+    """Re-assert the JAX_PLATFORMS env var via jax.config (hook-proof)."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+        jax.config.update("jax_platforms", platforms)
