@@ -41,10 +41,11 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
     max_batch_size: int = 8
     replace_with_kernel_inject: bool = False   # parity no-op: jit fuses
     min_out_tokens: int = 1
-    #: "int8" = weight-only quantized serving: matmul weights stored int8
-    #: with per-channel scales, dequantized in VMEM inside the Pallas
-    #: qmatmul. Halves weight HBM (serve ~2x larger models per chip);
-    #: see ops/quantized_linear.py for the measured speed tradeoff
+    #: "int8" | "fp8" = weight-only quantized serving: matmul weights
+    #: stored int8 (uniform grid) or float8_e4m3fn, with per-channel
+    #: scales, dequantized in VMEM inside the Pallas qmatmul. Halves
+    #: weight HBM (serve ~2x larger models per chip); see
+    #: ops/quantized_linear.py for the measured speed tradeoff
     weight_quant: Optional[str] = None
 
     @property
@@ -95,7 +96,7 @@ class InferenceEngineTPU:
 
         tp = self.mesh.shape["model"] > 1
         if config.weight_quant and tp:
-            raise ValueError("weight_quant=int8 requires tp_size=1 / a "
+            raise ValueError(f"weight_quant={config.weight_quant} requires tp_size=1 / a "
                              "mesh with model axis 1 (quantized leaves "
                              "are not TP-sharded)")
         specs = partition_specs(model, zero_stage=0, tp=tp)
@@ -120,7 +121,8 @@ class InferenceEngineTPU:
 
         if config.weight_quant:
             from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
-            self.params = quantize_param_tree(self.params)
+            self.params = quantize_param_tree(self.params,
+                                              mode=config.weight_quant)
 
         # KV cache sharded over batch (DP axes) and kv heads (model axis
         # when divisible)

@@ -48,7 +48,7 @@ class RaggedInferenceConfig(TPUConfigModel):
     max_batch_tokens: int = 2048     #: scheduler token budget per step
     prefill_chunk: int = 256         #: SplitFuse chunk width
     use_pallas: Optional[bool] = None  #: None = auto (TPU only)
-    weight_quant: Optional[str] = None  #: "int8" weight-only serving
+    weight_quant: Optional[str] = None  #: "int8" | "fp8" weight-only serving
 
 
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,
@@ -244,7 +244,8 @@ class RaggedInferenceEngineTPU:
                            else init_params(model, rng))
         if config.weight_quant:
             from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
-            self.params = quantize_param_tree(self.params)
+            self.params = quantize_param_tree(self.params,
+                                              mode=config.weight_quant)
         self.arena = pa.init_arena(model.num_layers, model.kv_heads,
                                    config.num_blocks, config.block_size,
                                    model.head_dim, self.dtype)

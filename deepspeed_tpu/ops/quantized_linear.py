@@ -1,4 +1,4 @@
-"""Int8 weight-only quantized serving (Pallas dequant-in-VMEM matmul).
+"""Int8 / fp8-e4m3 weight-only quantized serving (Pallas dequant-in-VMEM matmul).
 
 Reference analogue: the weight-quantized inference linears
 (inference/quantization/ + module_inject/module_quantize.py and the
@@ -36,10 +36,27 @@ from deepspeed_tpu.utils.logging import logger
 SCALE_SUFFIX = "_scale"
 
 
-def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """[K, N] float → (int8 [K, N], f32 scale [N]); symmetric per-output-
-    channel. Works on stacked [L, K, N] too (scale [L, N])."""
+#: e4m3fn max finite value — the fp8 analogue of int8's 127
+_E4M3_MAX = 448.0
+
+
+def quantize_weight(w: jax.Array, mode: str = "int8"
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """[K, N] float → (quantized [K, N], f32 scale [N]); symmetric
+    per-output-channel. Works on stacked [L, K, N] too (scale [L, N]).
+
+    ``mode="int8"``: uniform 8-bit grid (scale = max|w|/127).
+    ``mode="fp8"``: float8_e4m3fn storage (scale = max|w|/448) — same
+    byte width, but the exponent bits spend precision where weights
+    cluster near zero; reference analogue: ops/fp_quantizer (FP6-LLM /
+    fp8_gemm), here serving-only like the int8 path.
+    """
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    if mode == "fp8":
+        scale = jnp.maximum(absmax / _E4M3_MAX, 1e-12)
+        q = (w.astype(jnp.float32) / scale[..., None, :]).astype(
+            jnp.float8_e4m3fn)
+        return q, scale
     scale = jnp.maximum(absmax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
                  -127, 127).astype(jnp.int8)
@@ -101,7 +118,8 @@ def _qmm(x: jax.Array, w: jax.Array, scale: jax.Array, bm: int, bn: int,
 def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
             out_dtype=None,
             interpret: Optional[bool] = None) -> jax.Array:
-    """x [M, K] (bf16/f32) @ int8 w_q [K, N] with per-channel scale [N].
+    """x [M, K] (bf16/f32) @ int8-or-fp8 w_q [K, N] with per-channel
+    scale [N].
 
     Pads M up to a sublane multiple; falls back to an XLA dequant matmul
     off-TPU or for non-tileable K/N.
@@ -130,12 +148,13 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
 def validate_weight_quant(mode) -> None:
     """Shared early validation for the engines' ``weight_quant`` knob —
     fails before any parameter materialization."""
-    if mode is not None and mode != "int8":
-        raise ValueError(f"weight_quant '{mode}' unsupported; only 'int8'")
+    if mode is not None and mode not in ("int8", "fp8"):
+        raise ValueError(
+            f"weight_quant '{mode}' unsupported; expected 'int8' or 'fp8'")
 
 
 def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
-                                         "wi")):
+                                         "wi"), mode: str = "int8"):
     """Replace 2-D(+stacked) matmul leaves named in ``targets`` inside
     ``params['layers']`` with (int8, ``<name>_scale``) pairs, quantize an
     untied ``lm_head``, and for tied embeddings add a TRANSPOSED int8
@@ -148,11 +167,14 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
     dequant path yet, and quantizing only attention would silently
     under-deliver the promised memory halving.
     """
+    validate_weight_quant(mode)
     if "moe" in params.get("layers", {}):
         raise NotImplementedError(
-            "weight_quant=int8 does not cover MoE expert weights yet "
+            f"weight_quant={mode} does not cover MoE expert weights yet "
             "(the GShard einsum dispatch has no dequant path); serve "
             "MoE models unquantized")
+    if "lm_head" + SCALE_SUFFIX in params or "lm_head_q" in params:
+        raise ValueError("quantize_param_tree: tree is already quantized")
     out = {k: v for k, v in params.items()}
     layers = {k: v for k, v in params["layers"].items()}
     for group in ("attn", "mlp"):
@@ -160,20 +182,25 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
             continue
         g = {k: v for k, v in layers[group].items()}
         for name in targets:
-            if name in g and g[name].ndim >= 2 and \
-                    jnp.issubdtype(g[name].dtype, jnp.floating):
-                q, s = quantize_weight(g[name])
+            # the scale-leaf check (not dtype) keeps this idempotent:
+            # fp8 leaves ARE a floating dtype, and re-quantizing an
+            # already-scaled leaf silently destroys the weights
+            if name in g and name + SCALE_SUFFIX not in g and \
+                    g[name].ndim >= 2 and \
+                    jnp.issubdtype(g[name].dtype, jnp.floating) and \
+                    g[name].dtype != jnp.float8_e4m3fn:
+                q, s = quantize_weight(g[name], mode)
                 g[name] = q
                 g[name + SCALE_SUFFIX] = s
         layers[group] = g
     out["layers"] = layers
     if "lm_head" in out:
-        q, s = quantize_weight(out["lm_head"])
+        q, s = quantize_weight(out["lm_head"], mode)
         out["lm_head"] = q
         out["lm_head" + SCALE_SUFFIX] = s
     else:
         emb = out["embed"]["tokens"]           # [V, D] → logits copy [D, V]
-        q, s = quantize_weight(emb.T)
+        q, s = quantize_weight(emb.T, mode)
         out["lm_head_q"] = q
         out["lm_head_q" + SCALE_SUFFIX] = s
     return out

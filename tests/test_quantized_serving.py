@@ -1,8 +1,8 @@
-"""Int8 weight-only quantized serving (ops/quantized_linear.py).
+"""Int8 / fp8 weight-only quantized serving (ops/quantized_linear.py).
 
 Reference analogue: inference/quantization/ + module_inject/
-module_quantize.py (weight-quantized inference linears) and the int8
-kernels under csrc/quantization/.
+module_quantize.py (weight-quantized inference linears), the int8
+kernels under csrc/quantization/, and csrc/fp_quantizer (fp8).
 """
 
 import numpy as np
@@ -27,6 +27,18 @@ def test_quantize_roundtrip_error_bound():
     assert (err <= bound[None, :] + 1e-7).all()
 
 
+def test_quantize_roundtrip_fp8():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(256, 512)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w, mode="fp8")
+    assert q.dtype == jnp.float8_e4m3fn and s.shape == (512,)
+    back = np.asarray(dequantize_weight(q, s))
+    wn = np.asarray(w)
+    # e4m3: 3 mantissa bits → relative error <= 2^-4 per normalized elt
+    rel = np.linalg.norm(back - wn) / np.linalg.norm(wn)
+    assert rel < 2 ** -4, rel
+
+
 def test_quantize_stacked_layers():
     rng = np.random.default_rng(1)
     w = jnp.asarray(rng.normal(size=(4, 256, 512)), jnp.float32)
@@ -35,11 +47,12 @@ def test_quantize_stacked_layers():
 
 
 @pytest.mark.parametrize("m", [1, 16, 100])
-def test_qmatmul_matches_dequant_reference(m):
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_qmatmul_matches_dequant_reference(m, mode):
     rng = np.random.default_rng(2)
     x = jnp.asarray(rng.normal(size=(m, 256)), jnp.float32)
     w = jnp.asarray(rng.normal(size=(256, 512)) * 0.05, jnp.float32)
-    q, s = quantize_weight(w)
+    q, s = quantize_weight(w, mode)
     ref = x @ dequantize_weight(q, s)
     out = qmatmul(x, q, s, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -63,8 +76,9 @@ def _logits(cfg, params, tokens):
                                           jnp.asarray(tokens)))
 
 
-def test_quantized_forward_close_to_float(devices):
-    """Whole-model check: int8 weight-only logits stay close to the
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quantized_forward_close_to_float(devices, mode):
+    """Whole-model check: 8-bit weight-only logits stay close to the
     float model (the near-lossless claim, and the wiring through
     linear_2d/lm_logits)."""
     from deepspeed_tpu.models.llama import llama3_config
@@ -72,27 +86,31 @@ def test_quantized_forward_close_to_float(devices):
     cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256,
                         tie_embeddings=True)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    qp = quantize_param_tree(params)
-    assert qp["layers"]["attn"]["wq"].dtype == jnp.int8
+    qp = quantize_param_tree(params, mode=mode)
+    assert qp["layers"]["attn"]["wq"].dtype == (
+        jnp.int8 if mode == "int8" else jnp.float8_e4m3fn)
     assert "lm_head_q" in qp                      # tied → transposed copy
 
     tokens = np.arange(1, 17, dtype=np.int32)[None]
     lf = _logits(cfg, params, tokens)
     lq = _logits(cfg, qp, tokens)
     cos = np.sum(lf * lq) / (np.linalg.norm(lf) * np.linalg.norm(lq))
-    assert cos > 0.999, cos
+    # fp8 (3 mantissa bits) is a coarser grid than per-channel int8
+    cos_min, rel_max = (0.999, 0.05) if mode == "int8" else (0.997, 0.09)
+    assert cos > cos_min, cos
     rel = np.linalg.norm(lq - lf) / np.linalg.norm(lf)
-    assert rel < 0.05, rel
+    assert rel < rel_max, rel
 
 
-def test_quantized_v1_engine_generates(devices):
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quantized_v1_engine_generates(devices, mode):
     from deepspeed_tpu.parallel.mesh import build_mesh
     from deepspeed_tpu.inference.engine import InferenceEngineTPU
     from deepspeed_tpu.models.llama import llama3_config
     build_mesh(data=8)
     cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
     eng = InferenceEngineTPU(cfg, {"dtype": "float32",
-                                   "weight_quant": "int8",
+                                   "weight_quant": mode,
                                    "max_out_tokens": 32},
                              rng=jax.random.PRNGKey(0))
     out = eng.generate(np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0),
@@ -119,6 +137,22 @@ def test_quantized_ragged_engine_generates(devices):
     assert len(outs) == 3
     for o in outs:
         assert (np.asarray(o) < 256).all()
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+@pytest.mark.parametrize("tied", [True, False])
+def test_quantize_param_tree_rejects_double_apply(devices, mode, tied):
+    """Re-quantizing an already-quantized tree must fail loudly, not
+    silently destroy the weights (fp8 leaves are a floating dtype, so a
+    dtype check alone would re-quantize them)."""
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models import transformer
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256,
+                        tie_embeddings=tied)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_param_tree(params, mode=mode)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_param_tree(qp, mode=mode)
 
 
 def test_weight_quant_rejects_tp(devices):
@@ -150,7 +184,7 @@ def test_weight_quant_invalid_mode_fails_fast(devices):
     from deepspeed_tpu.models.llama import llama3_config
     build_mesh(data=8)
     cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
-    with pytest.raises(ValueError, match="only 'int8'"):
+    with pytest.raises(ValueError, match="'int8' or 'fp8'"):
         InferenceEngineTPU(cfg, {"weight_quant": "int4"})
-    with pytest.raises(ValueError, match="only 'int8'"):
-        RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp8"})
+    with pytest.raises(ValueError, match="'int8' or 'fp8'"):
+        RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp6"})
