@@ -99,6 +99,15 @@ class InferenceEngineTPU:
             raise ValueError(f"weight_quant={config.weight_quant} requires tp_size=1 / a "
                              "mesh with model axis 1 (quantized leaves "
                              "are not TP-sharded)")
+        if config.weight_quant and model.num_experts and \
+                self.mesh.shape["expert"] > 1:
+            raise ValueError(
+                f"weight_quant={config.weight_quant} requires an expert "
+                "mesh axis of 1: GSPMD replicates the opaque grouped "
+                "dequant kernel, silently losing both the EP sharding and "
+                "the memory halving — quantized MoE serving is a "
+                "single-chip capacity feature (same precedent as the TP "
+                "restriction above)")
         specs = partition_specs(model, zero_stage=0, tp=tp)
         self._param_sh = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,

@@ -145,6 +145,82 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     return out[:m] if mp != m else out
 
 
+def _qmm_batched_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
+    k = pl.program_id(3)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x_blk = x_ref[0]
+    w_blk = w_ref[0].astype(jnp.bfloat16)
+    acc_ref[...] += lax.dot_general(
+        x_blk, w_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[...] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
+
+
+def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                    out_dtype=None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Grouped weight-only matmul: x [G, M, K] @ w_q [G, K, N] (int8 or
+    fp8) with per-group per-channel scale [G, N] → [G, M, N].
+
+    The MoE expert FFN path (parallel/moe.py): G is the expert dim of the
+    GShard ``ecd,edh->ech`` einsums — the reference's analogue is the
+    cutlass grouped moe_gemm (inference/v2/kernels/cutlass_ops/moe_gemm)
+    over int8 expert weights. One Pallas grid dim per group keeps each
+    expert's weight stream resident in VMEM exactly once per tile pass.
+
+    Falls back to an XLA dequant einsum off-TPU or for non-tileable K/N.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g, m, k = x.shape
+    n = w_q.shape[2]
+    bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
+    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    out_dtype = out_dtype or x.dtype
+    if not bk or not bn:
+        logger.warning(
+            f"qmatmul_batched: K={k}/N={n} not tileable; using XLA dequant "
+            "path (materializes fp32 expert weights — 4x the quantized "
+            "HBM footprint)")
+        w = w_q.astype(jnp.float32) * scale[:, None, :]
+        return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
+                          w).astype(out_dtype)
+    mp = max(8, -(-m // 8) * 8)
+    bm = mp if mp <= 256 else 256
+    if mp % bm:
+        mp = -(-mp // bm) * bm
+    xp = x if mp == m else jnp.pad(x, ((0, 0), (0, mp - m), (0, 0)))
+    nk = k // bk
+    s3 = scale.astype(jnp.float32).reshape(g, 1, n)
+    kw = {}
+    if not interpret:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_qmm_batched_kernel, nk=nk),
+        grid=(g, mp // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((1, bm, bk), lambda gg, i, j, kk: (gg, i, kk)),
+            pl.BlockSpec((1, bk, bn), lambda gg, i, j, kk: (gg, kk, j)),
+            pl.BlockSpec((1, 1, bn), lambda gg, i, j, kk: (gg, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, bn), lambda gg, i, j, kk: (gg, i, j)),
+        out_shape=jax.ShapeDtypeStruct((g, mp, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        **kw,
+    )(xp, w_q, s3)
+    return out[:, :m] if mp != m else out
+
+
 def validate_weight_quant(mode) -> None:
     """Shared early validation for the engines' ``weight_quant`` knob —
     fails before any parameter materialization."""
@@ -162,21 +238,35 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
     float for the token lookup; per-step HBM traffic is what matters and
     the logits matmul only ever reads the int8 copy).
 
+    MoE expert weights (wg/wi/wo stacked on the expert dim) quantize to
+    per-expert per-channel scales and route through ``qmatmul_batched``
+    (the reference's analogue: the int8 grouped moe_gemm under
+    inference/v2/kernels/cutlass_ops); the router and the tiny
+    shared-expert gate stay float.
+
     Inference-only: the quantized leaves carry no gradient path.
-    MoE models are rejected: the expert einsum dispatch has no
-    dequant path yet, and quantizing only attention would silently
-    under-deliver the promised memory halving.
     """
     validate_weight_quant(mode)
-    if "moe" in params.get("layers", {}):
-        raise NotImplementedError(
-            f"weight_quant={mode} does not cover MoE expert weights yet "
-            "(the GShard einsum dispatch has no dequant path); serve "
-            "MoE models unquantized")
     if "lm_head" + SCALE_SUFFIX in params or "lm_head_q" in params:
         raise ValueError("quantize_param_tree: tree is already quantized")
     out = {k: v for k, v in params.items()}
     layers = {k: v for k, v in params["layers"].items()}
+    if "moe" in layers:
+        moe = {k: v for k, v in layers["moe"].items()}
+        for name in ("wg", "wi", "wo"):
+            if name in moe and name + SCALE_SUFFIX not in moe:
+                q, s = quantize_weight(moe[name], mode)
+                moe[name] = q
+                moe[name + SCALE_SUFFIX] = s
+        if "shared" in moe:
+            sh = {k: v for k, v in moe["shared"].items()}
+            for name in ("wg", "wi", "wo"):
+                if name in sh and name + SCALE_SUFFIX not in sh:
+                    q, s = quantize_weight(sh[name], mode)
+                    sh[name] = q
+                    sh[name + SCALE_SUFFIX] = s
+            moe["shared"] = sh
+        layers["moe"] = moe
     for group in ("attn", "mlp"):
         if group not in layers:
             continue

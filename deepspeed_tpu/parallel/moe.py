@@ -147,11 +147,28 @@ def moe_layer(cfg, p, x: jax.Array,
         buf = lax.with_sharding_constraint(
             buf, NamedSharding(ep_mesh, P(ep_axis, None, None)))
 
-    # expert FFN (SwiGLU family; per-expert weights on the E dim)
-    gate = jnp.einsum("ecd,edh->ech", buf, p["wg"])
-    up = jnp.einsum("ecd,edh->ech", buf, p["wi"])
-    hidden = jax.nn.silu(gate) * up
-    out_buf = jnp.einsum("ech,ehd->ecd", hidden, p["wo"])
+    # expert FFN (SwiGLU family; per-expert weights on the E dim); a
+    # wg_scale leaf (ops/quantized_linear.py suffix convention, attached
+    # by the engines' weight_quant config) routes the grouped matmuls
+    # through the Pallas batched dequant kernel — int8/fp8 expert
+    # weights at half the HBM (serving-only; under EP>1 the opaque
+    # kernel is replicated by GSPMD, so quantized MoE serving is meant
+    # for single-chip capacity, like the TP restriction)
+    from deepspeed_tpu.ops.quantized_linear import SCALE_SUFFIX
+    if "wg" + SCALE_SUFFIX in p:
+        from deepspeed_tpu.ops.quantized_linear import qmatmul_batched
+        gate = qmatmul_batched(buf, p["wg"], p["wg_scale"],
+                               out_dtype=buf.dtype)
+        up = qmatmul_batched(buf, p["wi"], p["wi_scale"],
+                             out_dtype=buf.dtype)
+        hidden = jax.nn.silu(gate) * up
+        out_buf = qmatmul_batched(hidden, p["wo"], p["wo_scale"],
+                                  out_dtype=buf.dtype)
+    else:
+        gate = jnp.einsum("ecd,edh->ech", buf, p["wg"])
+        up = jnp.einsum("ecd,edh->ech", buf, p["wi"])
+        hidden = jax.nn.silu(gate) * up
+        out_buf = jnp.einsum("ech,ehd->ecd", hidden, p["wo"])
 
     if ep_mesh is not None:
         out_buf = lax.with_sharding_constraint(
@@ -161,10 +178,19 @@ def moe_layer(cfg, p, x: jax.Array,
 
     if "shared" in p:   # Qwen2-MoE/DeepSeek: dense expert on every token
         sh = p["shared"]
-        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
-        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
-        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
-                           sh["wo"])
+        if "wg" + SCALE_SUFFIX in sh:
+            from deepspeed_tpu.ops.quantized_linear import qmatmul
+            gate_s = qmatmul(xf, sh["wg"], sh["wg_scale"],
+                             out_dtype=xf.dtype)
+            up_s = qmatmul(xf, sh["wi"], sh["wi_scale"],
+                           out_dtype=xf.dtype)
+            s_out = qmatmul(jax.nn.silu(gate_s) * up_s, sh["wo"],
+                            sh["wo_scale"], out_dtype=xf.dtype)
+        else:
+            gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
+            up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
+            s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
+                               sh["wo"])
         if "gate" in sh:
             s_out = s_out * jax.nn.sigmoid(
                 jnp.einsum("sd,do->so", xf.astype(jnp.float32),
