@@ -19,12 +19,13 @@ from deepspeed_tpu.models.gemma import gemma_config
 from deepspeed_tpu.models.bloom import bloom_config
 from deepspeed_tpu.models.gpt_bigcode import gpt_bigcode_config
 from deepspeed_tpu.models.qwen2_moe import qwen2_moe_config
+from deepspeed_tpu.models.gptj import gptj_config
 
 __all__ = [
     "DecoderConfig", "init_params", "forward", "partition_specs",
     "cross_entropy_loss", "dot_product_attention",
     "gpt2_config", "llama3_config", "mixtral_config",
     "mistral_config", "qwen2_config", "falcon_config", "gptneox_config",
-    "gpt_bigcode_config", "qwen2_moe_config",
+    "gpt_bigcode_config", "qwen2_moe_config", "gptj_config",
     "phi_config", "opt_config", "gemma_config", "bloom_config",
 ]

@@ -32,7 +32,7 @@ Params = Any
 
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
               "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
-              "phi", "phi3", "gpt_bigcode")
+              "phi", "phi3", "gpt_bigcode", "gptj")
 
 
 def _map_hf_act(act: str) -> str:
@@ -70,6 +70,25 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             parallel_block=bool(hf.get("use_parallel_residual", True)),
             parallel_block_norms=2)
+    if mt == "gptj":
+        dh = hf["n_embd"] // hf["n_head"]
+        return DecoderConfig(
+            hidden_size=hf["n_embd"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("n_positions", 2048),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation_function",
+                                          "gelu_new")),
+            pos_emb="rope",
+            rotary_pct=float(hf.get("rotary_dim") or dh) / dh,
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=True, attn_bias=False,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            lm_head_bias=True,
+            parallel_block=True, parallel_block_norms=1)
     if mt == "gpt2":
         return DecoderConfig(
             hidden_size=hf["n_embd"],
@@ -370,6 +389,24 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             hf["num_ln_in_parallel_attn"] = cfg.parallel_block_norms
         return hf
     if (cfg.parallel_block and not cfg.has_ln2 and cfg.use_bias
+            and not cfg.qkv_bias and cfg.pos_emb == "rope"
+            and cfg.lm_head_bias and not cfg.tie_embeddings
+            and cfg.kv_heads == cfg.num_heads
+            # GPTJConfig has NO rope-base slot: a non-default theta must
+            # fall through to the no-layout error, not silently reload
+            # in transformers with the hardcoded 10000
+            and cfg.rope_theta == 10000.0
+            and _no_exotics(cfg) and not cfg.embed_norm):   # GPT-J
+        return {**base, "model_type": "gptj",
+                "architectures": ["GPTJForCausalLM"],
+                "n_embd": cfg.hidden_size, "n_layer": cfg.num_layers,
+                "n_head": cfg.num_heads, "n_positions": cfg.max_seq_len,
+                "n_inner": cfg.ffn_size,
+                "rotary_dim": cfg.rope_dim,
+                "layer_norm_epsilon": cfg.norm_eps,
+                "activation_function": act_name()}
+    if (cfg.parallel_block and not cfg.has_ln2 and cfg.use_bias
+            and cfg.qkv_bias
             and cfg.pos_emb == "rope" and _no_exotics(cfg)
             and not cfg.embed_norm):   # Phi
         return {**base, "model_type": "phi",
@@ -514,6 +551,8 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         return cfg, _load_phi(cfg, get, dtype)
     if mt == "phi3":
         return cfg, _load_phi3(cfg, get, names, dtype)
+    if mt == "gptj":
+        return cfg, _load_gptj(cfg, get, dtype)
 
     def T(name):
         return np.ascontiguousarray(get(name).astype(dtype).T)
@@ -1000,6 +1039,58 @@ def _load_phi3(cfg: DecoderConfig, get, names, dtype) -> Params:
     return _attach_untied_head(params, cfg, get, names, dtype)
 
 
+def _gptj_rope_perm(cfg: DecoderConfig, inverse: bool = False) -> np.ndarray:
+    """Per-head column permutation folding GPT-J's INTERLEAVED rotary
+    pairing (HF rotate_every_two: pair (2j, 2j+1) gets frequency j) into
+    this repo's rotate-half convention (pair (j, j+rot/2) gets frequency
+    j): new position j takes original 2j, new j+rot/2 takes 2j+1, tail
+    dims pass through. Both conventions then compute identical attention
+    scores because q and k share the permutation. Same trick as the
+    Meta→HF llama weight conversion, in the other direction."""
+    dh, rot = cfg.head_dim, cfg.rope_dim
+    perm = np.concatenate([np.arange(0, rot, 2), np.arange(1, rot, 2),
+                           np.arange(rot, dh)])
+    if inverse:
+        perm = np.argsort(perm)
+    full = np.concatenate([perm + h * dh for h in range(cfg.num_heads)])
+    return full
+
+
+def _load_gptj(cfg: DecoderConfig, get, dtype) -> Params:
+    """GPT-J layout: parallel residual with ONE shared ln_1, bias-less
+    q/k/v/out_proj, biased fc_in/fc_out, interleaved partial rotary
+    (folded into the q/k permutation above), untied lm_head WITH bias."""
+    L = cfg.num_layers
+    p = "transformer.h.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+    perm = _gptj_rope_perm(cfg)
+    layers = {
+        "attn": {
+            "wq": stackT(p + "attn.q_proj.weight")[:, :, perm],
+            "wk": stackT(p + "attn.k_proj.weight")[:, :, perm],
+            "wv": stackT(p + "attn.v_proj.weight"),
+            "wo": stackT(p + "attn.out_proj.weight"),
+        },
+        "ln1": {"scale": stack(p + "ln_1.weight"),
+                "bias": stack(p + "ln_1.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.fc_in.weight"),
+            "bi": stack(p + "mlp.fc_in.bias"),
+            "wo": stackT(p + "mlp.fc_out.weight"),
+            "bo": stack(p + "mlp.fc_out.bias"),
+        },
+    }
+    return {
+        "embed": {"tokens": get("transformer.wte.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dtype),
+            "bias": get("transformer.ln_f.bias").astype(dtype)},
+        "lm_head": np.ascontiguousarray(get("lm_head.weight").astype(dtype).T),
+        "lm_head_bias": get("lm_head.bias").astype(dtype),
+    }
+
+
 def _load_phi(cfg: DecoderConfig, get, dtype) -> Params:
     """Phi layout: parallel residual with ONE shared input layernorm,
     separate biased q/k/v/dense projections, partial rotary, untied
@@ -1046,7 +1137,7 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
         return _export_neox(cfg, params, out_dir)
     cfg_hf = config_to_hf(cfg)   # raises on unsupported layouts
     if cfg_hf["model_type"] in ("gpt2", "opt", "bloom", "falcon", "phi",
-                                "gpt_bigcode"):
+                                "gpt_bigcode", "gptj"):
         return _export_classic(cfg, cfg_hf, params, out_dir)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -1303,6 +1394,25 @@ def _export_classic(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
                 put_ln(p + "input_layernorm", lyr["ln1"], i)
         if not cfg.tie_embeddings:
             out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "gptj":
+        inv = _gptj_rope_perm(cfg, inverse=True)
+        out["transformer.wte.weight"] = host["embed"]["tokens"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            out[p + "attn.q_proj.weight"] = C(a["wq"][i][:, inv].T)
+            out[p + "attn.k_proj.weight"] = C(a["wk"][i][:, inv].T)
+            out[p + "attn.v_proj.weight"] = C(a["wv"][i].T)
+            out[p + "attn.out_proj.weight"] = C(a["wo"][i].T)
+            out[p + "mlp.fc_in.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.fc_in.bias"] = m["bi"][i]
+            out[p + "mlp.fc_out.weight"] = C(m["wo"][i].T)
+            out[p + "mlp.fc_out.bias"] = m["bo"][i]
+            put_ln(p + "ln_1", lyr["ln1"], i)
+        out["lm_head.weight"] = C(host["lm_head"].T)
+        out["lm_head.bias"] = host.get(
+            "lm_head_bias", np.zeros(cfg.vocab_size, np.float32))
     else:   # phi
         out["model.embed_tokens.weight"] = host["embed"]["tokens"]
         out["model.final_layernorm.weight"] = host["final_norm"]["scale"]

@@ -63,6 +63,10 @@ class DecoderConfig:
     #: LayerNorm bias independent of linear biases (Falcon: bias-less
     #: linears but LNs WITH bias). None → follow use_bias.
     norm_bias: Optional[bool] = None
+    #: attention-projection biases independent of the MLP/LN biases
+    #: (GPT-J: biased fc_in/fc_out/LN but bias-less q/k/v/out_proj).
+    #: None → follow use_bias.
+    attn_bias: Optional[bool] = None
     #: partial rotary (GPT-NeoX rotary_pct / GPT-J rotary_dim): RoPE on
     #: the first rotary_pct of each head's dims, pass-through on the rest
     rotary_pct: float = 1.0
@@ -114,6 +118,10 @@ class DecoderConfig:
     @property
     def is_glu(self) -> bool:
         return self.activation.endswith("_glu")
+
+    @property
+    def qkv_bias(self) -> bool:
+        return self.use_bias if self.attn_bias is None else self.attn_bias
 
     @property
     def ln_bias(self) -> bool:
@@ -481,7 +489,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
         "wv": w(keys[2], (L, d, kd)),
         "wo": w(keys[3], (L, qd, d), std=cfg.init_std / math.sqrt(2 * L)),
     }
-    if cfg.use_bias:
+    if cfg.qkv_bias:
         attn.update(bq=jnp.zeros((L, qd), dtype), bk=jnp.zeros((L, kd), dtype),
                     bv=jnp.zeros((L, kd), dtype), bo=jnp.zeros((L, d), dtype))
 
@@ -862,7 +870,7 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
         "wv": spec(None, fsdp, model),
         "wo": spec(None, model, fsdp),
     }
-    if cfg.use_bias:
+    if cfg.qkv_bias:
         attn.update(bq=spec(None, model), bk=spec(None, model),
                     bv=spec(None, model), bo=spec(None, None))
 

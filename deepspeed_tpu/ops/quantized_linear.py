@@ -251,27 +251,9 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
         raise ValueError("quantize_param_tree: tree is already quantized")
     out = {k: v for k, v in params.items()}
     layers = {k: v for k, v in params["layers"].items()}
-    if "moe" in layers:
-        moe = {k: v for k, v in layers["moe"].items()}
-        for name in ("wg", "wi", "wo"):
-            if name in moe and name + SCALE_SUFFIX not in moe:
-                q, s = quantize_weight(moe[name], mode)
-                moe[name] = q
-                moe[name + SCALE_SUFFIX] = s
-        if "shared" in moe:
-            sh = {k: v for k, v in moe["shared"].items()}
-            for name in ("wg", "wi", "wo"):
-                if name in sh and name + SCALE_SUFFIX not in sh:
-                    q, s = quantize_weight(sh[name], mode)
-                    sh[name] = q
-                    sh[name + SCALE_SUFFIX] = s
-            moe["shared"] = sh
-        layers["moe"] = moe
-    for group in ("attn", "mlp"):
-        if group not in layers:
-            continue
-        g = {k: v for k, v in layers[group].items()}
-        for name in targets:
+    def quantize_group(group, names):
+        g = {k: v for k, v in group.items()}
+        for name in names:
             # the scale-leaf check (not dtype) keeps this idempotent:
             # fp8 leaves ARE a floating dtype, and re-quantizing an
             # already-scaled leaf silently destroys the weights
@@ -282,7 +264,17 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
                 q, s = quantize_weight(g[name], mode)
                 g[name] = q
                 g[name + SCALE_SUFFIX] = s
-        layers[group] = g
+        return g
+
+    if "moe" in layers:
+        moe = quantize_group(layers["moe"], ("wg", "wi", "wo"))
+        if "shared" in moe:
+            moe["shared"] = quantize_group(moe["shared"],
+                                           ("wg", "wi", "wo"))
+        layers["moe"] = moe
+    for group in ("attn", "mlp"):
+        if group in layers:
+            layers[group] = quantize_group(layers[group], targets)
     out["layers"] = layers
     if "lm_head" in out:
         q, s = quantize_weight(out["lm_head"], mode)

@@ -423,6 +423,43 @@ def test_phi_cached_decode_matches_forward(tmp_path):
                                np.asarray(full[:, -1]), rtol=1e-3, atol=1e-3)
 
 
+def test_gptj_logits_parity(tmp_path):
+    """GPT-J: INTERLEAVED partial rotary (rotate_every_two) folded into a
+    load-time q/k column permutation, bias-less attention but biased MLP,
+    untied lm_head WITH bias."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+    cfg = GPTJConfig(n_embd=64, n_layer=2, n_head=4, n_inner=256,
+                     vocab_size=256, n_positions=128, rotary_dim=8)
+    torch.manual_seed(10)
+    model = GPTJForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_gptj")
+    model.save_pretrained(d, safe_serialization=True)
+    got = _parity(model, d)
+    assert got.rotary_pct == 0.5 and not got.qkv_bias and got.lm_head_bias
+
+
+def test_gptj_cached_decode_matches_forward(tmp_path):
+    """The rope permutation must be consistent between the full forward
+    and the KV-cached decode path (both use the same rotate-half)."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+    cfg = GPTJConfig(n_embd=64, n_layer=2, n_head=4, n_inner=256,
+                     vocab_size=256, n_positions=128, rotary_dim=8)
+    torch.manual_seed(11)
+    GPTJForCausalLM(cfg).eval().save_pretrained(
+        str(tmp_path / "hf_gptj2"), safe_serialization=True)
+    dcfg, params = load_hf_checkpoint(str(tmp_path / "hf_gptj2"))
+    params = jax.tree.map(jnp.asarray, params)
+    tokens = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    full = transformer.forward(dcfg, params, tokens)
+    cache = transformer.init_kv_cache(dcfg, 1, 16)
+    logits = None
+    for t in range(tokens.shape[1]):
+        logits, cache = transformer.forward_with_cache(
+            dcfg, params, tokens[:, t:t + 1], cache, t)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, -1]), rtol=1e-3, atol=1e-3)
+
+
 def test_falcon_biased_logits_parity(tmp_path):
     """Falcon with config bias=true (falcon-rw lineage): fused qkv biases
     must be un-packed with the same per-variant layout as the weights."""
@@ -441,7 +478,8 @@ def test_falcon_biased_logits_parity(tmp_path):
 
 
 @pytest.mark.parametrize("family", ["gpt2", "opt", "bloom", "falcon_mqa",
-                                    "falcon_new", "falcon_bias2", "phi"])
+                                    "falcon_new", "falcon_bias2", "phi",
+                                    "gptj"])
 def test_classic_export_roundtrip(family, tmp_path):
     """Export a random classic-family model, reload via transformers, match
     logits — the reverse mapping incl. fused-qkv re-pack and OPT's +2
@@ -451,6 +489,7 @@ def test_classic_export_roundtrip(family, tmp_path):
     from deepspeed_tpu.models.bloom import bloom_config
     from deepspeed_tpu.models.falcon import falcon_config
     from deepspeed_tpu.models.phi import phi_config
+    from deepspeed_tpu.models.gptj import gptj_config
     make = {
         "gpt2": lambda: gpt2_config("tiny"),
         "opt": lambda: opt_config("tiny"),
@@ -464,6 +503,7 @@ def test_classic_export_roundtrip(family, tmp_path):
                                               parallel_block_norms=2,
                                               use_bias=True),
         "phi": lambda: phi_config("tiny"),
+        "gptj": lambda: gptj_config("tiny"),
     }[family]
     cfg = make()
     params = transformer.init_params(cfg, jax.random.PRNGKey(11))
