@@ -1,14 +1,21 @@
-"""1-bit Adam — communication-compressed optimizer family.
+"""1-bit Adam / 1-bit LAMB — communication-compressed optimizer family.
 
-Reference: ``runtime/fp16/onebit/adam.py:14`` (OnebitAdam), ``zoadam.py``
-(0/1 Adam), over the compressed backends (runtime/comm/nccl.py:52). The
-algorithm: a **warmup** phase (``freeze_step`` steps) runs exact Adam with
-full-precision gradient averaging while the variance estimate stabilizes;
-after the freeze the variance is FROZEN and each worker updates its
-momentum with its LOCAL gradient, then exchanges only the SIGN bits of the
-momentum through the error-feedback 1-bit allreduce
-(comm/compressed.py) — 32× less traffic per step, the blogs' up-to-26×
-comm reduction.
+Reference: ``runtime/fp16/onebit/adam.py:14`` (OnebitAdam), ``lamb.py:16``
+(OnebitLamb), ``zoadam.py`` (0/1 Adam), over the compressed backends
+(runtime/comm/nccl.py:52). The algorithm: a **warmup** phase
+(``freeze_step`` steps) runs exact Adam with full-precision gradient
+averaging while the variance estimate stabilizes; after the freeze the
+variance is FROZEN and each worker updates its momentum with its LOCAL
+gradient, then exchanges only the SIGN bits of the momentum through the
+error-feedback 1-bit allreduce (comm/compressed.py) — 32× less traffic
+per step, the blogs' up-to-26× comm reduction.
+
+1-bit LAMB adds layerwise adaptation: during warmup the exact LAMB trust
+ratio ||w||/||update|| is applied per parameter leaf and its EMA
+recorded; in the compressed phase the update is scaled by the FROZEN
+per-leaf coefficient (reference lamb.py freezes ``scaling_coeff`` the
+same way — fresh trust ratios can't be computed without exact global
+statistics).
 
 TPU design: one explicit ``shard_map`` step over 'data' (quantized/
 compressed collectives can't be expressed as GSPMD annotations — same
@@ -35,7 +42,8 @@ from deepspeed_tpu.comm.compressed import (compressed_allreduce,
 from deepspeed_tpu.runtime.zero.offload import FlatLayout
 from deepspeed_tpu.utils.logging import log_dist
 
-ONEBIT_NAMES = ("onebitadam", "onebit_adam", "zerooneadam")
+ONEBIT_NAMES = ("onebitadam", "onebit_adam", "zerooneadam",
+                "onebitlamb", "onebit_lamb")
 
 
 def validate_onebit(engine) -> None:
@@ -81,6 +89,10 @@ def init_onebit_state(engine) -> None:
         "serr": jax.device_put(
             jnp.zeros((world, padded // world), jnp.float32), dp),
         "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        # per-leaf LAMB trust-ratio EMA (frozen after warmup); carried
+        # by the adam variants too so the state treedef is uniform
+        "coeff": jax.device_put(
+            jnp.ones((len(layout.sizes),), jnp.float32), rep),
     }
     engine._state_shardings = jax.tree.map(
         lambda x: x.sharding, engine.opt_state)
@@ -106,6 +118,27 @@ def build_onebit_step(engine) -> None:
     eps = float(p.get("eps", 1e-8))
     wd = float(p.get("weight_decay", 0.0))
     freeze_step = int(p.get("freeze_step", 100))
+    is_lamb = "lamb" in cfg.optimizer.type.lower()
+    # LAMB trust-ratio clip + EMA factor (reference lamb.py max_coeff /
+    # min_coeff / coeff_beta)
+    coeff_max = float(p.get("max_coeff", 10.0))
+    coeff_min = float(p.get("min_coeff", 0.01))
+    coeff_beta = float(p.get("coeff_beta", 0.9))
+    n_seg = len(layout.sizes)
+    seg_ids = jnp.asarray(np.repeat(np.arange(n_seg), layout.sizes),
+                          jnp.int32)
+
+    def seg_trust(master, upd):
+        """Per-leaf LAMB trust ratio ||w||/||upd||, clipped; zero-norm
+        leaves (zero-initialized biases at step 1) get the reference's
+        neutral 1.0 (lamb.py: lamb_coeff=1 when either norm is 0) — the
+        clip floor would otherwise freeze them 100x down."""
+        wn = jnp.sqrt(jax.ops.segment_sum(master * master, seg_ids,
+                                          num_segments=n_seg))
+        un = jnp.sqrt(jax.ops.segment_sum(upd * upd, seg_ids,
+                                          num_segments=n_seg))
+        trust = jnp.clip(wn / jnp.maximum(un, 1e-12), coeff_min, coeff_max)
+        return jnp.where((wn == 0) | (un == 0), 1.0, trust)
 
     def body(params, opt, batch, step, rng):
         def micro(carry, mb):
@@ -152,17 +185,31 @@ def build_onebit_step(engine) -> None:
         upd = (m1 / bc1) / (jnp.sqrt(v1 / bc2) + eps)
         if wd:
             upd = upd + wd * master
+        coeff = opt["coeff"]
+        if is_lamb:
+            # warmup: exact per-leaf trust ratio, EMA recorded; after the
+            # freeze the EMA is FROZEN and reused (reference lamb.py
+            # scaling_coeff freeze)
+            in_warmup = t_new <= freeze_step
+            trust_now = seg_trust(master, upd)
+            trust = jnp.where(in_warmup, trust_now, coeff)
+            coeff = jnp.where(
+                in_warmup,
+                coeff_beta * coeff + (1 - coeff_beta) * trust_now, coeff)
+            upd = upd * trust[seg_ids]
         master1 = master - lr * upd
         new_flat = master1.astype(compute_dtype)
         loss = lax.pmean(jnp.mean(losses), "data")
         mnorm = jnp.sqrt(jnp.sum(jnp.square(m1)))
         new_opt = {"master": master1, "m": m1, "v": v1,
-                   "werr": w2[None], "serr": s2[None], "step": t_new}
+                   "werr": w2[None], "serr": s2[None], "step": t_new,
+                   "coeff": coeff}
         return new_flat, new_opt, loss, mnorm, lr
 
     param_specs = jax.tree.map(lambda _: P(), engine.params)
     opt_specs = {"master": P(), "m": P(), "v": P(),
-                 "werr": P("data"), "serr": P("data"), "step": P()}
+                 "werr": P("data"), "serr": P("data"), "step": P(),
+                 "coeff": P()}
 
     def fused_step(params, opt_state, scaler, batch, step, rng):
         batch_specs = jax.tree.map(

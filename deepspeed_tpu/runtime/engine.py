@@ -141,10 +141,13 @@ class DeepSpeedTPUEngine:
             tuple(n.replace("_", "") for n in ONEBIT_NAMES)
         if self._onebit_enabled:
             # the Optimizer object only contributes base_lr/hyperparams;
-            # the 1-bit step path (ops/onebit.py) owns the update
+            # the 1-bit step path (ops/onebit.py) owns the update, so the
+            # 1-bit-only knobs must not reach the adam factory
+            _onebit_only = ("freeze_step", "max_coeff", "min_coeff",
+                            "coeff_beta")
             opt_params = {k: v for k, v in
                           (config.optimizer.params or {}).items()
-                          if k != "freeze_step"}
+                          if k not in _onebit_only}
             self.optimizer, base_lr = build_optimizer("adamw", opt_params)
         else:
             self.optimizer, base_lr = build_optimizer(

@@ -50,6 +50,30 @@ def test_onebit_compressed_stage_converges(devices):
     assert float(jnp.abs(eng.opt_state["werr"]).sum()) > 0
 
 
+def test_onebit_lamb_converges_and_freezes_coeff(devices):
+    """1-bit LAMB: per-leaf trust-ratio EMA adapts during warmup, then
+    freezes in the compressed stage (reference lamb.py scaling_coeff)."""
+    eng, losses = _train({"type": "onebitlamb",
+                          "params": {"lr": 5e-3, "freeze_step": 4}},
+                         steps=8)
+    coeff_at_8 = np.asarray(jax.device_get(eng.opt_state["coeff"]))
+    # warmup moved the EMA off its init of 1.0 for at least some leaves
+    assert np.abs(coeff_at_8 - 1.0).max() > 1e-3
+    # trust ratios are clipped into [min_coeff, max_coeff]
+    assert (coeff_at_8 >= 0.01 - 1e-9).all() and \
+        (coeff_at_8 <= 10.0 + 1e-9).all()
+    # loss still falls in the compressed stage
+    assert losses[-1] < losses[4] < losses[0]
+
+    # two more compressed steps must NOT change the frozen coefficients
+    rng = np.random.default_rng(42)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    eng.train_batch(iter([batch]))
+    coeff_at_9 = np.asarray(jax.device_get(eng.opt_state["coeff"]))
+    np.testing.assert_array_equal(coeff_at_8, coeff_at_9)
+
+
 def test_onebit_rejects_zero_stage(devices):
     model = gpt2_config("tiny", max_seq_len=32, vocab_size=128)
     build_mesh(data=8)
