@@ -81,6 +81,11 @@ class InferenceEngineTPU:
                  mesh=None):
         if isinstance(config, dict) or config is None:
             config = DeepSpeedTPUInferenceConfig(**(config or {}))
+        if not model.causal:
+            raise ValueError(
+                "InferenceEngineTPU generates autoregressively; "
+                "encoder (bidirectional) models have no decode loop — "
+                "run transformer.forward directly for BERT-class models")
         self.model_config = model
         self.config = config
         from deepspeed_tpu.ops.quantized_linear import validate_weight_quant

@@ -207,6 +207,16 @@ class RaggedInferenceEngineTPU:
                  params=None, rng: Optional[jax.Array] = None):
         if isinstance(config, dict) or config is None:
             config = RaggedInferenceConfig(**(config or {}))
+        if not model.causal or model.layer_window_pattern is not None:
+            # the paged kernels are full-causal per layer: encoders have
+            # no decode loop at all, and GPT-Neo's local layers would
+            # silently attend beyond their window
+            raise NotImplementedError(
+                "ragged/paged inference supports full-causal decoder "
+                "models only (got "
+                f"causal={model.causal}, layer_window_pattern="
+                f"{model.layer_window_pattern}); use InferenceEngineTPU "
+                "for GPT-Neo-class models")
         if model.sliding_window is not None and \
                 config.max_seq_len > model.sliding_window:
             # the paged kernels attend the full page table; beyond the

@@ -20,6 +20,8 @@ from deepspeed_tpu.models.bloom import bloom_config
 from deepspeed_tpu.models.gpt_bigcode import gpt_bigcode_config
 from deepspeed_tpu.models.qwen2_moe import qwen2_moe_config
 from deepspeed_tpu.models.gptj import gptj_config
+from deepspeed_tpu.models.bert import bert_config, distilbert_config
+from deepspeed_tpu.models.gptneo import gptneo_config
 
 __all__ = [
     "DecoderConfig", "init_params", "forward", "partition_specs",
@@ -28,4 +30,5 @@ __all__ = [
     "mistral_config", "qwen2_config", "falcon_config", "gptneox_config",
     "gpt_bigcode_config", "qwen2_moe_config", "gptj_config",
     "phi_config", "opt_config", "gemma_config", "bloom_config",
+    "bert_config", "distilbert_config", "gptneo_config",
 ]

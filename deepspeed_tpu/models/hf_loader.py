@@ -32,7 +32,8 @@ Params = Any
 
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
               "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
-              "phi", "phi3", "gpt_bigcode", "gptj")
+              "phi", "phi3", "gpt_bigcode", "gptj", "bert", "distilbert",
+              "gpt_neo")
 
 
 def _map_hf_act(act: str) -> str:
@@ -52,6 +53,37 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
     if mt not in _FAMILIES:
         raise ValueError(f"unsupported model_type '{mt}'; "
                          f"supported: {_FAMILIES}")
+    if mt == "bert":
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("hidden_act", "gelu")),
+            pos_emb="learned",
+            norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
+            use_bias=True, tie_embeddings=True,
+            causal=False, prenorm=False, embed_norm=True,
+            type_vocab_size=int(hf.get("type_vocab_size", 2)),
+            mlm_head=True)
+    if mt == "distilbert":
+        return DecoderConfig(
+            hidden_size=hf["dim"],
+            num_layers=hf["n_layers"],
+            num_heads=hf["n_heads"],
+            intermediate_size=hf["hidden_dim"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation", "gelu")),
+            pos_emb="learned",
+            norm_eps=1e-12,
+            use_bias=True, tie_embeddings=True,
+            causal=False, prenorm=False, embed_norm=True,
+            type_vocab_size=0, mlm_head=True)
     if mt == "gpt_neox":
         return DecoderConfig(
             hidden_size=hf["hidden_size"],
@@ -89,6 +121,30 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             lm_head_bias=True,
             parallel_block=True, parallel_block_norms=1)
+    if mt == "gpt_neo":
+        window = int(hf.get("window_size", 256))
+        at = hf.get("attention_types") or \
+            [[["global", "local"], hf["num_layers"] // 2]]
+        kinds = []
+        for types, count in at:
+            kinds.extend(list(types) * int(count))
+        pattern = tuple(0 if k == "global" else window for k in kinds)
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_layers"],
+            num_heads=hf["num_heads"],
+            intermediate_size=hf.get("intermediate_size")
+            or 4 * hf["hidden_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=_map_hf_act(hf.get("activation_function",
+                                          "gelu_new")),
+            pos_emb="learned",
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            use_bias=True, attn_bias=False, attn_out_bias=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            layer_window_pattern=pattern)
     if mt == "gpt2":
         return DecoderConfig(
             hidden_size=hf["n_embd"],
@@ -269,7 +325,9 @@ def _no_exotics(cfg: DecoderConfig) -> bool:
     branches, or the export silently drops the feature."""
     return (not cfg.num_experts and cfg.head_dim_override is None
             and not cfg.scale_embeddings and not cfg.logit_softcap
-            and cfg.sliding_window is None and not cfg.is_glu)
+            and cfg.sliding_window is None and not cfg.is_glu
+            and cfg.layer_window_pattern is None
+            and cfg.attn_out_bias is None)
 
 
 def _is_neox_layout(cfg: DecoderConfig) -> bool:
@@ -294,6 +352,46 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
             return "relu"
         return exact_name if cfg.activation == "gelu_exact" else tanh_name
 
+    if not cfg.causal or not cfg.prenorm:
+        # encoder layouts (BERT/DistilBERT): both flags flip together
+        if cfg.causal or cfg.prenorm or cfg.pos_emb != "learned" \
+                or cfg.norm != "layernorm" or not cfg.mlm_head \
+                or not _no_exotics(cfg) or not cfg.embed_norm:
+            raise ValueError(
+                "config_to_hf: no HF layout for this encoder config "
+                f"(causal={cfg.causal} prenorm={cfg.prenorm} "
+                f"pos_emb={cfg.pos_emb}); supported encoder exports: "
+                "bert (type_vocab_size>0), distilbert")
+        if cfg.type_vocab_size:
+            return {
+                "model_type": "bert",
+                "architectures": ["BertForMaskedLM"],
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "intermediate_size": cfg.ffn_size,
+                "vocab_size": cfg.vocab_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "type_vocab_size": cfg.type_vocab_size,
+                "layer_norm_eps": cfg.norm_eps,
+                "hidden_act": act_name(),
+                "tie_word_embeddings": True,
+                "torch_dtype": "float32",
+            }
+        return {
+            "model_type": "distilbert",
+            "architectures": ["DistilBertForMaskedLM"],
+            "dim": cfg.hidden_size,
+            "n_layers": cfg.num_layers,
+            "n_heads": cfg.num_heads,
+            "hidden_dim": cfg.ffn_size,
+            "vocab_size": cfg.vocab_size,
+            "max_position_embeddings": cfg.max_seq_len,
+            "activation": act_name(),
+            "sinusoidal_pos_embds": False,
+            "tie_weights_": True,
+            "torch_dtype": "float32",
+        }
     if _is_neox_layout(cfg):
         return {
             "model_type": "gpt_neox",
@@ -317,6 +415,31 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "torch_dtype": "float32",
     }
+    if cfg.layer_window_pattern is not None:
+        # GPT-Neo: the only layout with per-layer window alternation
+        nz = {w for w in cfg.layer_window_pattern if w}
+        if (len(nz) > 1 or cfg.norm != "layernorm"
+                or cfg.pos_emb != "learned" or not cfg.use_bias
+                or cfg.qkv_bias or not cfg.out_bias
+                or cfg.parallel_block or cfg.num_experts):
+            raise ValueError(
+                "config_to_hf: layer_window_pattern only exports as "
+                "gpt_neo (layernorm, learned pos, bias-less qkv + biased "
+                "out, one distinct local window size); got "
+                f"pattern={cfg.layer_window_pattern}")
+        kinds = ["global" if w == 0 else "local"
+                 for w in cfg.window_per_layer()]
+        return {**base, "model_type": "gpt_neo",
+                "architectures": ["GPTNeoForCausalLM"],
+                "hidden_size": cfg.hidden_size,
+                "num_layers": cfg.num_layers,
+                "num_heads": cfg.num_heads,
+                "intermediate_size": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "window_size": next(iter(nz), 256),
+                "attention_types": [[[k], 1] for k in kinds],
+                "layer_norm_epsilon": cfg.norm_eps,
+                "activation_function": act_name()}
     untied_bias = cfg.lm_head_bias and not cfg.tie_embeddings
     if (cfg.norm == "layernorm" and cfg.pos_emb == "learned"
             and cfg.use_bias and not cfg.parallel_block
@@ -535,8 +658,14 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
     get, names = _reader(model_dir)
     L = cfg.num_layers
     mt = hf_cfg.get("model_type")
+    if mt == "bert":
+        return cfg, _load_bert(cfg, get, names, dtype)
+    if mt == "distilbert":
+        return cfg, _load_distilbert(cfg, get, names, dtype)
     if mt == "gpt_neox":
         return cfg, _load_neox(cfg, get, dtype)
+    if mt == "gpt_neo":
+        return cfg, _load_gptneo(cfg, get, names, dtype)
     if mt == "gpt2":
         return cfg, _load_gpt2(cfg, get, names, dtype)
     if mt == "gpt_bigcode":
@@ -695,6 +824,163 @@ def _load_neox(cfg: DecoderConfig, get, dtype) -> Params:
     if not cfg.tie_embeddings:
         params["lm_head"] = np.ascontiguousarray(
             get("embed_out.weight").astype(dtype).T)
+    return params
+
+
+def _load_gptneo(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """GPT-Neo layout (reference: module_inject/containers/gptneo.py):
+    separate bias-less q/k/v Linears + biased out_proj, GPT-2-style
+    ln/mlp naming but nn.Linear ([out, in]) weights. GPT-Neo computes
+    attention WITHOUT the 1/sqrt(dh) scale; we fold sqrt(dh) into wq at
+    load so the in-repo scaled kernels match exactly (exported back out
+    by _export_gptneo)."""
+    import math as _math
+    L = cfg.num_layers
+    stack, stackT = _stack_helpers(get, L, dtype)
+    p = "transformer.h.{}."
+    scale = np.asarray(_math.sqrt(cfg.head_dim), dtype)
+    layers = {
+        "attn": {
+            "wq": stackT(p + "attn.attention.q_proj.weight") * scale,
+            "wk": stackT(p + "attn.attention.k_proj.weight"),
+            "wv": stackT(p + "attn.attention.v_proj.weight"),
+            "wo": stackT(p + "attn.attention.out_proj.weight"),
+            "bo": stack(p + "attn.attention.out_proj.bias"),
+        },
+        "ln1": {"scale": stack(p + "ln_1.weight"),
+                "bias": stack(p + "ln_1.bias")},
+        "ln2": {"scale": stack(p + "ln_2.weight"),
+                "bias": stack(p + "ln_2.bias")},
+        "mlp": {
+            "wi": stackT(p + "mlp.c_fc.weight"),
+            "bi": stack(p + "mlp.c_fc.bias"),
+            "wo": stackT(p + "mlp.c_proj.weight"),
+            "bo": stack(p + "mlp.c_proj.bias"),
+        },
+    }
+    params: Params = {
+        "embed": {
+            "tokens": get("transformer.wte.weight").astype(dtype),
+            "pos": get("transformer.wpe.weight").astype(dtype),
+        },
+        "layers": layers,
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dtype),
+            "bias": get("transformer.ln_f.bias").astype(dtype)},
+    }
+    return _attach_untied_head(params, cfg, get, names, dtype)
+
+
+def _load_bert(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """BERT encoder layout (reference: module_inject/containers/bert.py).
+
+    Post-LN mapping: HF ``attention.output.LayerNorm`` → our ``ln1``
+    (applied after the attention residual), ``output.LayerNorm`` →
+    ``ln2``. Works for both ``BertForMaskedLM`` (``bert.``-prefixed +
+    ``cls.predictions`` head) and a bare ``BertModel`` checkpoint."""
+    L = cfg.num_layers
+    pre = "bert." if "bert.embeddings.word_embeddings.weight" in names \
+        else ""
+    stack, stackT = _stack_helpers(get, L, dtype)
+    p = pre + "encoder.layer.{}."
+    layers = {
+        "attn": {
+            "wq": stackT(p + "attention.self.query.weight"),
+            "wk": stackT(p + "attention.self.key.weight"),
+            "wv": stackT(p + "attention.self.value.weight"),
+            "wo": stackT(p + "attention.output.dense.weight"),
+            "bq": stack(p + "attention.self.query.bias"),
+            "bk": stack(p + "attention.self.key.bias"),
+            "bv": stack(p + "attention.self.value.bias"),
+            "bo": stack(p + "attention.output.dense.bias"),
+        },
+        "ln1": {"scale": stack(p + "attention.output.LayerNorm.weight"),
+                "bias": stack(p + "attention.output.LayerNorm.bias")},
+        "ln2": {"scale": stack(p + "output.LayerNorm.weight"),
+                "bias": stack(p + "output.LayerNorm.bias")},
+        "mlp": {
+            "wi": stackT(p + "intermediate.dense.weight"),
+            "bi": stack(p + "intermediate.dense.bias"),
+            "wo": stackT(p + "output.dense.weight"),
+            "bo": stack(p + "output.dense.bias"),
+        },
+    }
+    e = pre + "embeddings."
+    params: Params = {
+        "embed": {
+            "tokens": get(e + "word_embeddings.weight").astype(dtype),
+            "pos": get(e + "position_embeddings.weight").astype(dtype),
+            "token_type":
+                get(e + "token_type_embeddings.weight").astype(dtype),
+        },
+        "embed_norm": {"scale": get(e + "LayerNorm.weight").astype(dtype),
+                       "bias": get(e + "LayerNorm.bias").astype(dtype)},
+        "layers": layers,
+    }
+    if "cls.predictions.transform.dense.weight" in names:
+        t = "cls.predictions.transform."
+        params["mlm_head"] = {
+            "dense": np.ascontiguousarray(
+                get(t + "dense.weight").astype(dtype).T),
+            "dense_bias": get(t + "dense.bias").astype(dtype),
+            "ln": {"scale": get(t + "LayerNorm.weight").astype(dtype),
+                   "bias": get(t + "LayerNorm.bias").astype(dtype)},
+            "vocab_bias": get("cls.predictions.bias").astype(dtype),
+        }
+    return params
+
+
+def _load_distilbert(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """DistilBERT layout (reference: module_inject/containers/
+    distil_bert.py): BERT math without token types; the MLM head tensors
+    are top-level ``vocab_transform``/``vocab_layer_norm``/
+    ``vocab_projector`` (projector weight tied to the embeddings)."""
+    L = cfg.num_layers
+    pre = "distilbert." \
+        if "distilbert.embeddings.word_embeddings.weight" in names else ""
+    stack, stackT = _stack_helpers(get, L, dtype)
+    p = pre + "transformer.layer.{}."
+    layers = {
+        "attn": {
+            "wq": stackT(p + "attention.q_lin.weight"),
+            "wk": stackT(p + "attention.k_lin.weight"),
+            "wv": stackT(p + "attention.v_lin.weight"),
+            "wo": stackT(p + "attention.out_lin.weight"),
+            "bq": stack(p + "attention.q_lin.bias"),
+            "bk": stack(p + "attention.k_lin.bias"),
+            "bv": stack(p + "attention.v_lin.bias"),
+            "bo": stack(p + "attention.out_lin.bias"),
+        },
+        "ln1": {"scale": stack(p + "sa_layer_norm.weight"),
+                "bias": stack(p + "sa_layer_norm.bias")},
+        "ln2": {"scale": stack(p + "output_layer_norm.weight"),
+                "bias": stack(p + "output_layer_norm.bias")},
+        "mlp": {
+            "wi": stackT(p + "ffn.lin1.weight"),
+            "bi": stack(p + "ffn.lin1.bias"),
+            "wo": stackT(p + "ffn.lin2.weight"),
+            "bo": stack(p + "ffn.lin2.bias"),
+        },
+    }
+    e = pre + "embeddings."
+    params: Params = {
+        "embed": {
+            "tokens": get(e + "word_embeddings.weight").astype(dtype),
+            "pos": get(e + "position_embeddings.weight").astype(dtype),
+        },
+        "embed_norm": {"scale": get(e + "LayerNorm.weight").astype(dtype),
+                       "bias": get(e + "LayerNorm.bias").astype(dtype)},
+        "layers": layers,
+    }
+    if "vocab_transform.weight" in names:
+        params["mlm_head"] = {
+            "dense": np.ascontiguousarray(
+                get("vocab_transform.weight").astype(dtype).T),
+            "dense_bias": get("vocab_transform.bias").astype(dtype),
+            "ln": {"scale": get("vocab_layer_norm.weight").astype(dtype),
+                   "bias": get("vocab_layer_norm.bias").astype(dtype)},
+            "vocab_bias": get("vocab_projector.bias").astype(dtype),
+        }
     return params
 
 
@@ -1133,11 +1419,13 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     (single shard) + config.json — the reverse mapping, so models trained
     here load in transformers."""
     import jax
+    if not cfg.causal or not cfg.prenorm:
+        return _export_encoder(cfg, config_to_hf(cfg), params, out_dir)
     if _is_neox_layout(cfg):
         return _export_neox(cfg, params, out_dir)
     cfg_hf = config_to_hf(cfg)   # raises on unsupported layouts
     if cfg_hf["model_type"] in ("gpt2", "opt", "bloom", "falcon", "phi",
-                                "gpt_bigcode", "gptj"):
+                                "gpt_bigcode", "gptj", "gpt_neo"):
         return _export_classic(cfg, cfg_hf, params, out_dir)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -1214,6 +1502,97 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
                 np.ascontiguousarray(m["wi"][i].T)
             out[p.format(i) + "mlp.down_proj.weight"] = \
                 np.ascontiguousarray(m["wo"][i].T)
+    _save_hf(out, cfg_hf, out_dir)
+
+
+def _export_encoder(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
+                    params: Params, out_dir: str) -> None:
+    """Inverse of ``_load_bert`` / ``_load_distilbert``: write a
+    ``BertForMaskedLM`` / ``DistilBertForMaskedLM`` checkpoint
+    transformers can reload."""
+    import jax
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x), np.float32), params)
+    C = np.ascontiguousarray
+    lyr = host["layers"]
+    a, m = lyr["attn"], lyr["mlp"]
+    out: Dict[str, np.ndarray] = {}
+    bert = cfg_hf["model_type"] == "bert"
+    pre = "bert." if bert else "distilbert."
+    e = pre + "embeddings."
+    out[e + "word_embeddings.weight"] = host["embed"]["tokens"]
+    out[e + "position_embeddings.weight"] = host["embed"]["pos"]
+    if bert:
+        out[e + "token_type_embeddings.weight"] = \
+            host["embed"]["token_type"]
+    out[e + "LayerNorm.weight"] = host["embed_norm"]["scale"]
+    out[e + "LayerNorm.bias"] = host["embed_norm"]["bias"]
+    if bert:
+        name = {
+            "wq": "attention.self.query.weight",
+            "bq": "attention.self.query.bias",
+            "wk": "attention.self.key.weight",
+            "bk": "attention.self.key.bias",
+            "wv": "attention.self.value.weight",
+            "bv": "attention.self.value.bias",
+            "wo": "attention.output.dense.weight",
+            "bo": "attention.output.dense.bias",
+            "ln1": "attention.output.LayerNorm",
+            "ln2": "output.LayerNorm",
+            "wi": "intermediate.dense.weight",
+            "bi": "intermediate.dense.bias",
+            "wmo": "output.dense.weight",
+            "bmo": "output.dense.bias",
+        }
+        p = pre + "encoder.layer.{}."
+    else:
+        name = {
+            "wq": "attention.q_lin.weight", "bq": "attention.q_lin.bias",
+            "wk": "attention.k_lin.weight", "bk": "attention.k_lin.bias",
+            "wv": "attention.v_lin.weight", "bv": "attention.v_lin.bias",
+            "wo": "attention.out_lin.weight",
+            "bo": "attention.out_lin.bias",
+            "ln1": "sa_layer_norm", "ln2": "output_layer_norm",
+            "wi": "ffn.lin1.weight", "bi": "ffn.lin1.bias",
+            "wmo": "ffn.lin2.weight", "bmo": "ffn.lin2.bias",
+        }
+        p = pre + "transformer.layer.{}."
+    for i in range(cfg.num_layers):
+        q = p.format(i)
+        out[q + name["wq"]] = C(a["wq"][i].T)
+        out[q + name["bq"]] = a["bq"][i]
+        out[q + name["wk"]] = C(a["wk"][i].T)
+        out[q + name["bk"]] = a["bk"][i]
+        out[q + name["wv"]] = C(a["wv"][i].T)
+        out[q + name["bv"]] = a["bv"][i]
+        out[q + name["wo"]] = C(a["wo"][i].T)
+        out[q + name["bo"]] = a["bo"][i]
+        out[q + name["ln1"] + ".weight"] = lyr["ln1"]["scale"][i]
+        out[q + name["ln1"] + ".bias"] = lyr["ln1"]["bias"][i]
+        out[q + name["ln2"] + ".weight"] = lyr["ln2"]["scale"][i]
+        out[q + name["ln2"] + ".bias"] = lyr["ln2"]["bias"][i]
+        out[q + name["wi"]] = C(m["wi"][i].T)
+        out[q + name["bi"]] = m["bi"][i]
+        out[q + name["wmo"]] = C(m["wo"][i].T)
+        out[q + name["bmo"]] = m["bo"][i]
+    if "mlm_head" in host:
+        mh = host["mlm_head"]
+        if bert:
+            t = "cls.predictions.transform."
+            out[t + "dense.weight"] = C(mh["dense"].T)
+            out[t + "dense.bias"] = mh["dense_bias"]
+            out[t + "LayerNorm.weight"] = mh["ln"]["scale"]
+            out[t + "LayerNorm.bias"] = mh["ln"]["bias"]
+            out["cls.predictions.bias"] = mh["vocab_bias"]
+            out["cls.predictions.decoder.weight"] = host["embed"]["tokens"]
+            out["cls.predictions.decoder.bias"] = mh["vocab_bias"]
+        else:
+            out["vocab_transform.weight"] = C(mh["dense"].T)
+            out["vocab_transform.bias"] = mh["dense_bias"]
+            out["vocab_layer_norm.weight"] = mh["ln"]["scale"]
+            out["vocab_layer_norm.bias"] = mh["ln"]["bias"]
+            out["vocab_projector.weight"] = host["embed"]["tokens"]
+            out["vocab_projector.bias"] = mh["vocab_bias"]
     _save_hf(out, cfg_hf, out_dir)
 
 
@@ -1295,6 +1674,29 @@ def _export_classic(cfg: DecoderConfig, cfg_hf: Dict[str, Any],
                 [a["bq"][i], a["bk"][i], a["bv"][i]])
             out[p + "attn.c_proj.weight"] = C(a["wo"][i].T)
             out[p + "attn.c_proj.bias"] = a["bo"][i]
+            out[p + "mlp.c_fc.weight"] = C(m["wi"][i].T)
+            out[p + "mlp.c_fc.bias"] = m["bi"][i]
+            out[p + "mlp.c_proj.weight"] = C(m["wo"][i].T)
+            out[p + "mlp.c_proj.bias"] = m["bo"][i]
+            put_ln(p + "ln_1", lyr["ln1"], i)
+            put_ln(p + "ln_2", lyr["ln2"], i)
+        if not cfg.tie_embeddings:
+            out["lm_head.weight"] = C(host["lm_head"].T)
+    elif mt == "gpt_neo":
+        import math as _math
+        inv = np.float32(1.0 / _math.sqrt(cfg.head_dim))
+        out["transformer.wte.weight"] = host["embed"]["tokens"]
+        out["transformer.wpe.weight"] = host["embed"]["pos"]
+        out["transformer.ln_f.weight"] = host["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = host["final_norm"]["bias"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            # un-fold the sqrt(dh) loaded into wq (see _load_gptneo)
+            out[p + "attn.attention.q_proj.weight"] = C((a["wq"][i] * inv).T)
+            out[p + "attn.attention.k_proj.weight"] = C(a["wk"][i].T)
+            out[p + "attn.attention.v_proj.weight"] = C(a["wv"][i].T)
+            out[p + "attn.attention.out_proj.weight"] = C(a["wo"][i].T)
+            out[p + "attn.attention.out_proj.bias"] = a["bo"][i]
             out[p + "mlp.c_fc.weight"] = C(m["wi"][i].T)
             out[p + "mlp.c_fc.bias"] = m["bi"][i]
             out[p + "mlp.c_proj.weight"] = C(m["wo"][i].T)

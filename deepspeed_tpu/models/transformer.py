@@ -70,6 +70,14 @@ class DecoderConfig:
     #: partial rotary (GPT-NeoX rotary_pct / GPT-J rotary_dim): RoPE on
     #: the first rotary_pct of each head's dims, pass-through on the rest
     rotary_pct: float = 1.0
+    #: out-projection bias decoupled from the q/k/v biases (GPT-Neo:
+    #: bias-less q/k/v but biased out_proj). None → follow qkv_bias.
+    attn_out_bias: Optional[bool] = None
+    #: per-layer attention windows tiled over depth (GPT-Neo
+    #: attention_types: (0, 256) = alternating global/local-256; 0 means
+    #: full causal). Routes to the masked attention path — the static
+    #: block-skip kernels keep using ``sliding_window``.
+    layer_window_pattern: Optional[Tuple[int, ...]] = None
     # MoE (used by mixtral preset; dense when num_experts == 0)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -98,10 +106,43 @@ class DecoderConfig:
     sliding_window: Optional[int] = None
     #: untied lm_head carries a bias vector (HF Phi's ``lm_head.bias``)
     lm_head_bias: bool = False
+    #: False → bidirectional (encoder: BERT/DistilBERT). The reference's
+    #: encoder containers are module_inject/containers/bert.py and
+    #: distil_bert.py; here encoders are the same scan core with the
+    #: causal mask dropped.
+    causal: bool = True
+    #: False → post-LN residuals (original-transformer/BERT order:
+    #: h = LN(x + sublayer(x))); True → pre-LN (GPT-2/Llama). Post-LN
+    #: models have no final norm — the last block's output LN is it.
+    prenorm: bool = True
+    #: >0 → segment/token-type embeddings (BERT); adds an
+    #: ``embed["token_type"]`` leaf added before the embed norm
+    type_vocab_size: int = 0
+    #: BERT masked-LM head: transform dense+gelu+LN before the tied
+    #: decode, plus a vocab bias (HF cls.predictions.*)
+    mlm_head: bool = False
+
+    def __post_init__(self):
+        if self.mlm_head and not self.tie_embeddings:
+            # the MLM decode is defined as tied-embedding + vocab bias
+            # (HF cls.predictions.decoder); an untied lm_head would make
+            # lm_logits and the chunked-CE loss decode different heads
+            raise ValueError("mlm_head requires tie_embeddings=True")
 
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def has_final_norm(self) -> bool:
+        return self.prenorm
+
+    def window_per_layer(self):
+        """``layer_window_pattern`` tiled over depth as a plain list
+        (0 = full causal) — the ONE home for the expansion, shared by
+        the forward scan and the HF export."""
+        pat = self.layer_window_pattern
+        return [pat[i % len(pat)] for i in range(self.num_layers)]
 
     @property
     def head_dim(self) -> int:
@@ -122,6 +163,11 @@ class DecoderConfig:
     @property
     def qkv_bias(self) -> bool:
         return self.use_bias if self.attn_bias is None else self.attn_bias
+
+    @property
+    def out_bias(self) -> bool:
+        return self.qkv_bias if self.attn_out_bias is None \
+            else self.attn_out_bias
 
     @property
     def ln_bias(self) -> bool:
@@ -164,9 +210,11 @@ class DecoderConfig:
                     + (d if self.shared_expert_gate else 0)
         per_layer = attn + mlp + 2 * d
         emb = v * d + (self.max_seq_len * d if self.pos_emb == "learned"
-                       else 0)
+                       else 0) + self.type_vocab_size * d
         head = 0 if self.tie_embeddings else v * d + (v if self.lm_head_bias
                                                       else 0)
+        if self.mlm_head:
+            head += d * d + 3 * d + v
         return l * per_layer + emb + head + d
 
 
@@ -197,18 +245,23 @@ def _norm_params(cfg: DecoderConfig, shape_prefix=()) -> Params:
 
 def embed_tokens(cfg: DecoderConfig, em: Params, tokens: jax.Array,
                  positions: jax.Array,
-                 embed_norm: Optional[Params] = None) -> jax.Array:
+                 embed_norm: Optional[Params] = None,
+                 token_type_ids: Optional[jax.Array] = None) -> jax.Array:
     """The ONE home for token-embedding semantics (Gemma sqrt(d) scaling,
-    learned positions, BLOOM word_embeddings_layernorm) — shared by
-    forward_hidden, forward_with_cache, the pipeline stages, and the
-    ragged inference engine so a new embed-affecting knob can't silently
-    diverge between paths."""
+    learned positions, BLOOM word_embeddings_layernorm, BERT token-type
+    segments) — shared by forward_hidden, forward_with_cache, the
+    pipeline stages, and the ragged inference engine so a new
+    embed-affecting knob can't silently diverge between paths."""
     x = em["tokens"][tokens]
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.hidden_size)
              ).astype(x.dtype)
     if cfg.pos_emb == "learned":
         x = x + em["pos"][positions]
+    if cfg.type_vocab_size:
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros(tokens.shape, jnp.int32)
+        x = x + em["token_type"][token_type_ids]
     if cfg.embed_norm:
         x = _norm(cfg, embed_norm, x)
     return x
@@ -270,7 +323,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
                           q_offset: int = 0,
                           alibi: Optional[jax.Array] = None,
-                          window: Optional[int] = None) -> jax.Array:
+                          window: Optional[int] = None,
+                          key_mask: Optional[jax.Array] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     GQA handled by head repetition at the einsum level (no materialized
@@ -278,6 +332,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``alibi``: per-head slopes [H] → adds slope·(kpos − qpos) to the
     scores (BLOOM/Press-et-al. linear position bias). ``window``: causal
     sliding window (Mistral SWA) — key kp visible iff qp−window < kp ≤ qp.
+    ``key_mask``: [B, Tk] bool, False = padding key (HF attention_mask;
+    the correctness-critical case is padded ENCODER batches).
     """
     b, tq, h, dh = q.shape
     _, tk, kvh, _ = k.shape
@@ -296,8 +352,13 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = qpos[:, None] >= kpos[None, :] if causal else \
             jnp.ones((tq, tk), bool)
         if window is not None:
-            mask = mask & (kpos[None, :] > qpos[:, None] - window)
+            # ``window`` may be a traced per-layer scalar (GPT-Neo
+            # alternating local attention); <= 0 means full causal
+            w = jnp.asarray(window)
+            mask = mask & ((w <= 0) | (kpos[None, :] > qpos[:, None] - w))
         scores = jnp.where(mask[None, None, None], scores, -1e30)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(b, tq, h, dh)
@@ -309,13 +370,22 @@ AttentionFn = Callable[..., jax.Array]
 def default_attention(cfg: DecoderConfig) -> AttentionFn:
     """Config-correct plain attention: ALiBi models get their slopes baked
     in (a bare ``dot_product_attention`` would silently train a
-    position-free BLOOM)."""
+    position-free BLOOM), encoders (BERT) get the causal mask dropped."""
+    if not cfg.causal:
+        return partial(dot_product_attention, causal=False)
     if cfg.pos_emb == "alibi":
         return partial(dot_product_attention,
                        alibi=alibi_slopes(cfg.num_heads))
     if cfg.sliding_window is not None:
         return partial(dot_product_attention, window=cfg.sliding_window)
     return dot_product_attention
+
+
+def layer_windows(cfg: DecoderConfig) -> jax.Array:
+    """[L] int32 of per-layer attention windows (0 = full causal), the
+    ``layer_window_pattern`` tiled over depth — GPT-Neo's
+    ``attention_types`` expansion."""
+    return jnp.asarray(cfg.window_per_layer(), jnp.int32)
 
 
 def resolve_remat_policy(name: Optional[str]):
@@ -424,19 +494,24 @@ def attn_out_project(cfg: DecoderConfig, p: Params, out: jax.Array
 
 
 def _attention_block(cfg: DecoderConfig, p: Params, x: jax.Array,
-                     sin, cos, attn_fn: AttentionFn) -> jax.Array:
+                     sin, cos, attn_fn: AttentionFn,
+                     layer_window: Optional[jax.Array] = None) -> jax.Array:
     q, k, v = qkv_project(cfg, p, x, sin, cos)
-    return attn_out_project(cfg, p, attn_fn(q, k, v))
+    out = attn_fn(q, k, v) if layer_window is None \
+        else attn_fn(q, k, v, window=layer_window)
+    return attn_out_project(cfg, p, out)
 
 
 def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
                   attn_fn: AttentionFn,
-                  moe_fn: Optional[Callable] = None
+                  moe_fn: Optional[Callable] = None,
+                  layer_window: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hidden, aux_loss) — aux is 0 for dense blocks, the scaled
     load-balance loss for MoE blocks (reference sharded_moe.py l_aux)."""
-    pre = _norm(cfg, p["ln1"], x)
-    attn_out = _attention_block(cfg, p["attn"], pre, sin, cos, attn_fn)
+    pre = _norm(cfg, p["ln1"], x) if cfg.prenorm else x
+    attn_out = _attention_block(cfg, p["attn"], pre, sin, cos, attn_fn,
+                                layer_window)
     attn_out = checkpoint_name(attn_out, "attn_out")
     return block_combine(cfg, p, x, pre, attn_out, moe_fn)
 
@@ -451,12 +526,18 @@ def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
     the shared pre-norm (1-norm variants) or a separate ln2(x) (NeoX /
     Falcon-40B 2-norm variants); attention and MLP matmuls overlap on the
     MXU. Sequential (GPT-2/Llama): post-attention pre-norm MLP.
+    Post-LN (BERT/original transformer, prenorm=False):
+    h = ln1(x + attn(x)); out = ln2(h + mlp(h)).
     """
     def ffn(src):
         if cfg.num_experts and moe_fn is not None:
             return moe_fn(cfg, p["moe"], src)
         return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32)
 
+    if not cfg.prenorm:
+        h = _norm(cfg, p["ln1"], x + attn_out)
+        ff, aux = ffn(h)
+        return _norm(cfg, p["ln2"], h + ff), aux
     if cfg.parallel_block:
         src = _norm(cfg, p["ln2"], x) if cfg.parallel_block_norms == 2 \
             else pre
@@ -491,7 +572,9 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     }
     if cfg.qkv_bias:
         attn.update(bq=jnp.zeros((L, qd), dtype), bk=jnp.zeros((L, kd), dtype),
-                    bv=jnp.zeros((L, kd), dtype), bo=jnp.zeros((L, d), dtype))
+                    bv=jnp.zeros((L, kd), dtype))
+    if cfg.out_bias:
+        attn["bo"] = jnp.zeros((L, d), dtype)
 
     layers: Params = {
         "attn": attn,
@@ -537,12 +620,22 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     params: Params = {
         "embed": {"tokens": w(keys[8], (v, d))},
         "layers": layers,
-        "final_norm": _norm_params(cfg),
     }
+    if cfg.has_final_norm:
+        params["final_norm"] = _norm_params(cfg)
     if cfg.embed_norm:
         params["embed_norm"] = _norm_params(cfg)
     if cfg.pos_emb == "learned":
         params["embed"]["pos"] = w(keys[9], (cfg.max_seq_len, d))
+    if cfg.type_vocab_size:
+        params["embed"]["token_type"] = w(keys[11], (cfg.type_vocab_size, d))
+    if cfg.mlm_head:
+        params["mlm_head"] = {
+            "dense": w(keys[12], (d, d)),
+            "dense_bias": jnp.zeros((d,), dtype),
+            "ln": _norm_params(cfg),
+            "vocab_bias": jnp.zeros((v,), dtype),
+        }
     if not cfg.tie_embeddings:
         params["lm_head"] = w(keys[10], (d, v))
         if cfg.lm_head_bias:
@@ -558,21 +651,32 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
                    attn_fn: Optional[AttentionFn] = None,
                    moe_fn: Optional[Callable] = None,
                    positions: Optional[jax.Array] = None,
-                   remat_policy: Optional[str] = None
+                   remat_policy: Optional[str] = None,
+                   token_type_ids: Optional[jax.Array] = None,
+                   attention_mask: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, T] int32 → (final-norm hidden [B, T, D], MoE aux loss).
 
     Layers applied with ``lax.scan`` over the stacked pytree; optional
     ``jax.checkpoint`` per block (the reference's activation checkpointing
     runtime/activation_checkpointing/ → remat on TPU).
+
+    ``attention_mask``: [B, T] (1 = real, 0 = pad; HF convention). Only
+    needed for ENCODERS, where pad keys attend into every position;
+    right-padded decoder batches are already correct under the causal
+    mask (+ label -100). The selected ``attn_fn`` must accept
+    ``key_mask`` (the masked/chunked paths do; Pallas flash is
+    causal-only and never selected for encoders).
     """
     if attn_fn is None:
         attn_fn = default_attention(cfg)
+    if attention_mask is not None:
+        attn_fn = partial(attn_fn, key_mask=attention_mask.astype(bool))
     b, t = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     x = embed_tokens(cfg, params["embed"], tokens, positions,
-                     params.get("embed_norm"))
+                     params.get("embed_norm"), token_type_ids)
     if cfg.pos_emb == "rope":
         sin, cos = rope_table(cfg, positions)
     else:   # learned: applied in embed; alibi: bias in the attention impl
@@ -580,15 +684,25 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
 
     block = partial(decoder_block, cfg, attn_fn=attn_fn, moe_fn=moe_fn)
 
-    def body(carry, layer_params):
-        out, aux = block(layer_params, carry, sin, cos)
-        return out, aux
+    if cfg.layer_window_pattern:
+        def body(carry, xs):
+            layer_params, w = xs
+            out, aux = block(layer_params, carry, sin, cos, layer_window=w)
+            return out, aux
+        scan_xs = (params["layers"],
+                   layer_windows(cfg))
+    else:
+        def body(carry, layer_params):
+            out, aux = block(layer_params, carry, sin, cos)
+            return out, aux
+        scan_xs = params["layers"]
 
     if remat_policy and remat_policy != "none":
         body = jax.checkpoint(body, policy=resolve_remat_policy(remat_policy))
 
-    x, aux = lax.scan(body, x, params["layers"])
-    x = _norm(cfg, params["final_norm"], x)
+    x, aux = lax.scan(body, x, scan_xs)
+    if cfg.has_final_norm:
+        x = _norm(cfg, params["final_norm"], x)
     return x, jnp.sum(aux)
 
 
@@ -600,8 +714,31 @@ def _softcap(cfg: DecoderConfig, logits: jax.Array) -> jax.Array:
     return logits
 
 
-def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array) -> jax.Array:
-    """Final projection: hidden [B,T,D] → logits [B,T,V] fp32."""
+def mlm_transform(cfg: DecoderConfig, mh: Params, x: jax.Array) -> jax.Array:
+    """HF ``cls.predictions.transform``: dense + the config activation +
+    LN (shared by lm_logits and chunked_cross_entropy so the training
+    loss optimizes the exact serving logits)."""
+    x = jnp.einsum("btd,de->bte", x, mh["dense"]) + mh["dense_bias"]
+    if cfg.activation == "relu":
+        x = jax.nn.relu(x)
+    else:
+        x = jax.nn.gelu(x, approximate=cfg.activation != "gelu_exact")
+    return _norm(cfg, mh["ln"], x)
+
+
+def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array,
+              pre_transformed: bool = False) -> jax.Array:
+    """Final projection: hidden [B,T,D] → logits [B,T,V] fp32.
+
+    ``mlm_head`` models (BERT) first run the HF ``cls.predictions.
+    transform`` — dense+act+LN — then the tied decode plus vocab bias
+    (``pre_transformed=True`` when the caller already applied it)."""
+    if cfg.mlm_head and "mlm_head" in params:
+        if not pre_transformed:
+            x = mlm_transform(cfg, params["mlm_head"], x)
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"]["tokens"],
+                            preferred_element_type=jnp.float32)
+        return logits + params["mlm_head"]["vocab_bias"].astype(jnp.float32)
     q_name = "lm_head_q" if "lm_head_q" in params else \
         ("lm_head" if "lm_head_scale" in params else None)
     if q_name:   # int8 serving head (tied models carry a transposed copy)
@@ -628,12 +765,16 @@ def forward(cfg: DecoderConfig, params: Params, tokens: jax.Array,
             moe_fn: Optional[Callable] = None,
             positions: Optional[jax.Array] = None,
             remat_policy: Optional[str] = None,
-            with_aux: bool = False
+            with_aux: bool = False,
+            token_type_ids: Optional[jax.Array] = None,
+            attention_mask: Optional[jax.Array] = None
             ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
     """tokens → logits [B,T,V] fp32 (with_aux: plus MoE aux loss)."""
     x, aux = forward_hidden(cfg, params, tokens, attn_fn=attn_fn,
                             moe_fn=moe_fn, positions=positions,
-                            remat_policy=remat_policy)
+                            remat_policy=remat_policy,
+                            token_type_ids=token_type_ids,
+                            attention_mask=attention_mask)
     logits = lm_logits(cfg, params, x)
     if with_aux:
         return logits, aux
@@ -683,6 +824,12 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
     """
     b, t, d = x.shape
     v = cfg.vocab_size
+    # BERT-class heads: run the cls.predictions transform ONCE on the
+    # full hidden (a cheap [B,T,D]×[D,D]), so every path below — dense
+    # shortcut and chunk scan — decodes the exact serving logits
+    mlm = cfg.mlm_head and "mlm_head" in params
+    if mlm:
+        x = mlm_transform(cfg, params["mlm_head"], x)
     # chunk sizing follows the EMITTED logits dtype (bf16 chunks are half
     # the bytes, so the same budget buys twice the rows for the MXU); the
     # dense shortcut below stays a 4-byte bound — that path materializes
@@ -697,8 +844,9 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
         chunk = _pick_chunk(t, b, v, budget_bytes, max_chunk=t // 2,
                             elt_bytes=eb)
     if chunk >= t:
-        return cross_entropy_loss(lm_logits(cfg, params, x), targets,
-                                  ignore_index)
+        return cross_entropy_loss(
+            lm_logits(cfg, params, x, pre_transformed=True), targets,
+            ignore_index)
     w = params["embed"]["tokens"] if cfg.tie_embeddings else params["lm_head"]
     nc = t // chunk
     xs = jnp.moveaxis(x.reshape(b, nc, chunk, d), 1, 0)       # [nc,B,C,D]
@@ -717,6 +865,9 @@ def chunked_cross_entropy(cfg: DecoderConfig, params: Params, x: jax.Array,
         if cfg.tie_embeddings:
             logits = jnp.einsum("bcd,vd->bcv", xc, w,
                                 preferred_element_type=out_dt)
+            if mlm:
+                logits = logits + \
+                    params["mlm_head"]["vocab_bias"].astype(out_dt)
         else:
             logits = jnp.einsum("bcd,dv->bcv", xc, w,
                                 preferred_element_type=out_dt)
@@ -763,11 +914,12 @@ def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int,
 
 
 def _cached_attention(cfg: DecoderConfig, p: Params, x, sin, cos,
-                      k_cache, v_cache, cache_len):
+                      k_cache, v_cache, cache_len, layer_window=None):
     """One block's attention against the cache; returns (out, k_new, v_new).
 
     x: [B, t, D] new tokens; k_cache/v_cache: [B, Tmax, KvH, Dh];
-    cache_len: scalar int32 — tokens already cached.
+    cache_len: scalar int32 — tokens already cached. ``layer_window``:
+    traced per-layer window (GPT-Neo local layers; <=0 = full).
     """
     b, t, d = x.shape
     q, k, v = qkv_project(cfg, p, x, sin, cos)
@@ -794,6 +946,9 @@ def _cached_attention(cfg: DecoderConfig, p: Params, x, sin, cos,
     mask = qpos[:, None] >= kpos[None, :]
     if cfg.sliding_window is not None:
         mask = mask & (kpos[None, :] > qpos[:, None] - cfg.sliding_window)
+    if layer_window is not None:
+        w = jnp.asarray(layer_window)
+        mask = mask & ((w <= 0) | (kpos[None, :] > qpos[:, None] - w))
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v_cache)
@@ -820,17 +975,23 @@ def forward_with_cache(cfg: DecoderConfig, params: Params, tokens: jax.Array,
 
     def body(carry, layer):
         x = carry
-        layer_params, k_c, v_c = layer
-        h_in = _norm(cfg, layer_params["ln1"], x)
+        layer_params, k_c, v_c = layer[:3]
+        w = layer[3] if len(layer) > 3 else None
+        h_in = _norm(cfg, layer_params["ln1"], x) if cfg.prenorm else x
         attn_out, k_c, v_c = _cached_attention(
-            cfg, layer_params["attn"], h_in, sin, cos, k_c, v_c, cache_len)
+            cfg, layer_params["attn"], h_in, sin, cos, k_c, v_c, cache_len,
+            layer_window=w)
         out, _aux = block_combine(cfg, layer_params, x, h_in, attn_out,
                                   moe_fn)
         return out, (k_c, v_c)
 
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
-    x = _norm(cfg, params["final_norm"], x[:, -1:])
+    scan_xs = (params["layers"], cache["k"], cache["v"])
+    if cfg.layer_window_pattern:
+        scan_xs = scan_xs + (layer_windows(cfg),)
+    x, (k_new, v_new) = lax.scan(body, x, scan_xs)
+    x = x[:, -1:]
+    if cfg.has_final_norm:
+        x = _norm(cfg, params["final_norm"], x)
     logits = lm_logits(cfg, params, x)[:, 0]
     return logits, {"k": k_new, "v": v_new}
 
@@ -872,7 +1033,9 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
     }
     if cfg.qkv_bias:
         attn.update(bq=spec(None, model), bk=spec(None, model),
-                    bv=spec(None, model), bo=spec(None, None))
+                    bv=spec(None, model))
+    if cfg.out_bias:
+        attn["bo"] = spec(None, None)
 
     layers: Params = {
         "attn": attn,
@@ -924,10 +1087,19 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
     specs: Params = {
         "embed": {"tokens": spec(model, fsdp)},
         "layers": layers,
-        "final_norm": {"scale": spec(None)},
     }
-    if cfg.ln_bias:
-        specs["final_norm"]["bias"] = spec(None)
+    if cfg.has_final_norm:
+        specs["final_norm"] = {"scale": spec(None)}
+        if cfg.ln_bias:
+            specs["final_norm"]["bias"] = spec(None)
+    if cfg.type_vocab_size:
+        specs["embed"]["token_type"] = spec(None, fsdp)
+    if cfg.mlm_head:
+        mh = {"dense": spec(fsdp, None), "dense_bias": spec(None),
+              "ln": {"scale": spec(None)}, "vocab_bias": spec(model)}
+        if cfg.ln_bias:
+            mh["ln"]["bias"] = spec(None)
+        specs["mlm_head"] = mh
     if cfg.embed_norm:
         specs["embed_norm"] = {"scale": spec(None)}
         if cfg.ln_bias:

@@ -29,12 +29,16 @@ def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
                 q_start: int, k_start: int = 0, *,
                 causal: bool, scale: float,
                 alibi: Optional[jax.Array] = None,
-                window: Optional[int] = None) -> jax.Array:
+                window: Optional[int] = None,
+                key_mask: Optional[jax.Array] = None) -> jax.Array:
     """One query chunk vs a key slice starting at position ``k_start``.
 
     qg: [B, Cq, KV, G, Dh], k/v: [B, Tk, KV, Dh] → [B, Cq, KV, G, Dh].
     ``alibi``: per-head slopes [H] (BLOOM linear position bias).
     ``window``: causal sliding window (keys ≤ window behind the query).
+    ``key_mask``: [B, Tk] bool, False = padding key (HF attention_mask —
+    required for correctness on padded ENCODER batches, where padding
+    is upstream of every real token).
     """
     b, cq, kvh, g, dh = qg.shape
     tk = k.shape[1]
@@ -52,6 +56,9 @@ def _chunk_attn(qg: jax.Array, k: jax.Array, v: jax.Array,
         if window is not None:
             mask = mask & (kpos[None, :] > qpos[:, None] - window)
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, None, :], scores,
+                           _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bkgts,bskd->btkgd", probs, v)
 
@@ -61,7 +68,8 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       q_offset: int = 0,
                       chunk_q: int = 256,
                       alibi: Optional[jax.Array] = None,
-                      window: Optional[int] = None) -> jax.Array:
+                      window: Optional[int] = None,
+                      key_mask: Optional[jax.Array] = None) -> jax.Array:
     """q: [B, Tq, H, Dh], k/v: [B, Tk, KvH, Dh] → [B, Tq, H, Dh].
 
     The q-chunk loop is unrolled at trace time so each chunk attends to a
@@ -74,15 +82,16 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     _, tk, kvh, _ = k.shape
     if tq <= chunk_q:
         return dot_product_attention_ref(q, k, v, causal, q_offset, alibi,
-                                         window)
+                                         window, key_mask)
     g = h // kvh
     scale = 1.0 / math.sqrt(dh)
     qg = q.reshape(b, tq, kvh, g, dh)
 
-    chunk_fn = jax.checkpoint(
-        partial(_chunk_attn, causal=causal, scale=scale, alibi=alibi,
-                window=window),
-        static_argnums=(3, 4))
+    def chunk_fn(qc, kc, vc, q_start, k_lo, km):
+        return jax.checkpoint(
+            partial(_chunk_attn, causal=causal, scale=scale, alibi=alibi,
+                    window=window, key_mask=km),
+            static_argnums=(3, 4))(qc, kc, vc, q_start, k_lo)
 
     # full chunks plus a static remainder chunk for non-multiple lengths
     bounds = list(range(0, tq, chunk_q)) + [tq]
@@ -101,17 +110,21 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kc = jax.lax.slice_in_dim(k, k_lo, k_end, axis=1)
             vc = jax.lax.slice_in_dim(v, k_lo, k_end, axis=1)
         else:
+            k_end = tk
             kc, vc = k, v
-        outs.append(chunk_fn(qc, kc, vc, q_start, k_lo))
+        km = None if key_mask is None else \
+            jax.lax.slice_in_dim(key_mask, k_lo, k_end, axis=1)
+        outs.append(chunk_fn(qc, kc, vc, q_start, k_lo, km))
     return jnp.concatenate(outs, axis=1).reshape(b, tq, h, dh)
 
 
 def dot_product_attention_ref(q, k, v, causal=True, q_offset=0, alibi=None,
-                              window=None):
+                              window=None, key_mask=None):
     """Single-chunk fallback (same math, full prefix)."""
     b, tq, h, dh = q.shape
     kvh = k.shape[2]
     qg = q.reshape(b, tq, kvh, h // kvh, dh)
     out = _chunk_attn(qg, k, v, q_offset, causal=causal,
-                      scale=1.0 / math.sqrt(dh), alibi=alibi, window=window)
+                      scale=1.0 / math.sqrt(dh), alibi=alibi, window=window,
+                      key_mask=key_mask)
     return out.reshape(b, tq, h, dh)

@@ -58,16 +58,66 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
                 f"with sequence_parallel.size={sp.size}: custom impls "
                 f"must handle the 'seq' axis themselves — register an "
                 f"SP-aware fn or use a builtin impl")
+        if dec_cfg is not None and dec_cfg.layer_window_pattern:
+            # forward_hidden feeds a traced per-layer `window=` kwarg —
+            # a registered impl with the documented (q, k, v, causal=,
+            # q_offset=) signature would TypeError at trace time
+            raise ValueError(
+                f"attention_impl '{impl}' (registered) does not support "
+                f"per-layer attention windows (layer_window_pattern); "
+                f"use a builtin impl for GPT-Neo-class models")
         if dec_cfg is not None and (dec_cfg.pos_emb == "alibi"
-                                    or dec_cfg.sliding_window is not None):
+                                    or dec_cfg.sliding_window is not None
+                                    or not dec_cfg.causal):
             from deepspeed_tpu.utils.logging import warning_once
+            kind = ("ALiBi" if dec_cfg.pos_emb == "alibi" else
+                    "sliding-window" if dec_cfg.sliding_window is not None
+                    else "bidirectional (encoder)")
             warning_once(
                 f"attention_impl '{impl}' (registered) is used as-is for "
-                f"a model with "
-                f"{'ALiBi' if dec_cfg.pos_emb == 'alibi' else 'sliding-window'}"
-                f" attention — the impl itself must apply the "
-                f"bias/window or results will silently differ")
+                f"a model with {kind} attention — the impl itself must "
+                f"apply the bias/window/non-causal mask or results will "
+                f"silently differ")
         return _ATTENTION_REGISTRY[impl]
+    if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
+        raise ValueError(
+            f"unknown attention_impl '{impl}'; expected 'auto'|"
+            f"'pallas_flash'|'xla_chunked'|'naive' or a name registered "
+            f"via register_attention_impl ({sorted(_ATTENTION_REGISTRY)})")
+    if dec_cfg is not None and dec_cfg.layer_window_pattern:
+        # per-layer alternating windows (GPT-Neo): the window is a traced
+        # scalar fed from the layer scan, which only the masked reference
+        # path supports — the static block-skip kernels need a
+        # compile-time window
+        if sp.size > 1:
+            raise ValueError(
+                "sequence_parallel with per-layer attention windows "
+                "(layer_window_pattern) is not supported")
+        if impl in ("pallas_flash", "xla_chunked"):
+            # honor the explicit kernel choice with a loud error, not a
+            # silent downgrade
+            raise ValueError(
+                f"attention_impl '{impl}' cannot apply per-layer traced "
+                f"windows (layer_window_pattern); use 'auto' or 'naive' "
+                f"for GPT-Neo-class models")
+        return dot_product_attention
+    if dec_cfg is not None and not dec_cfg.causal:
+        # encoders (BERT): bidirectional attention. The Pallas flash
+        # kernel and the SP wrappers are causal-only today — route to
+        # the chunked-XLA path (full T² is inherent here anyway).
+        if sp.size > 1:
+            raise ValueError(
+                "sequence_parallel with a bidirectional (encoder) model "
+                "is not supported; use DP/TP for BERT-class models")
+        if impl == "pallas_flash":
+            raise ValueError(
+                "attention_impl 'pallas_flash' is causal-only; use "
+                "'auto'/'xla_chunked'/'naive' for encoder (BERT-class) "
+                "models")
+        if impl == "naive":
+            return partial(dot_product_attention, causal=False)
+        from deepspeed_tpu.ops.xla_attention import chunked_attention
+        return partial(chunked_attention, causal=False)
     if dec_cfg is not None and dec_cfg.pos_emb == "alibi":
         # ALiBi (BLOOM) adds a per-head score bias; the Pallas flash
         # kernel has no bias port, and head-sharded SP would need the
@@ -87,11 +137,6 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
             "sequence_parallel with sliding-window attention is not "
             "supported yet (the ring/Ulysses wrappers assume full causal "
             "attention); unset sliding_window or sequence_parallel")
-    if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
-        raise ValueError(
-            f"unknown attention_impl '{impl}'; expected 'auto'|"
-            f"'pallas_flash'|'xla_chunked'|'naive' or a name registered "
-            f"via register_attention_impl ({sorted(_ATTENTION_REGISTRY)})")
     if sp.size > 1 and sp.mode == "ring":
         from deepspeed_tpu.parallel.ring import ring_attention
         return partial(ring_attention, axis_name="seq")
@@ -187,9 +232,18 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             labels = jnp.concatenate(
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
         mf = _moe_for_step(rng)
+        # encoder extras (BERT): pad masking is correctness-critical for
+        # bidirectional attention (decoder batches right-pad + label
+        # -100, which the causal mask already handles)
+        enc = {}
+        if not dec_cfg.causal:
+            if "attention_mask" in batch:
+                enc["attention_mask"] = batch["attention_mask"]
+            if "token_type_ids" in batch:
+                enc["token_type_ids"] = batch["token_type_ids"]
         hidden, aux = transformer.forward_hidden(
             dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
-            remat_policy=remat)
+            remat_policy=remat, **enc)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
                                                  labels,
                                                  budget_bytes=ce_budget,
@@ -212,6 +266,22 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         assert dec_cfg.num_layers % stages == 0, (
             f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
             f"stages {stages}")
+        if not dec_cfg.causal or not dec_cfg.prenorm:
+            # the pipeline stages assume the pre-LN decoder layout
+            # (final_norm leaf, causal attention); silently pipelining a
+            # BERT would KeyError deep in the schedule
+            raise ValueError(
+                "pipeline parallelism does not support encoder "
+                "(bidirectional / post-LN) models; use DP/TP for "
+                "BERT-class models")
+        if dec_cfg.layer_window_pattern:
+            # pipeline stages build decoder_block without the per-layer
+            # window feed — training would silently run full attention
+            # on GPT-Neo's local layers
+            raise ValueError(
+                "pipeline parallelism does not support per-layer "
+                "attention windows (layer_window_pattern); use DP/TP "
+                "for GPT-Neo-class models")
         if ds_cfg.sequence_parallel.size > 1:
             # the SP attention wrappers are shard_maps over 'seq'; nesting
             # them inside the pipeline's partial-manual 'pipe' region
