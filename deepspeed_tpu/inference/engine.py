@@ -41,11 +41,12 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
     max_batch_size: int = 8
     replace_with_kernel_inject: bool = False   # parity no-op: jit fuses
     min_out_tokens: int = 1
-    #: "int8" | "fp8" = weight-only quantized serving: matmul weights
-    #: stored int8 (uniform grid) or float8_e4m3fn, with per-channel
-    #: scales, dequantized in VMEM inside the Pallas qmatmul. Halves
-    #: weight HBM (serve ~2x larger models per chip); see
-    #: ops/quantized_linear.py for the measured speed tradeoff
+    #: "int8" | "fp8" | "int4" = weight-only quantized serving: matmul
+    #: weights stored int8 (uniform grid), float8_e4m3fn, or two int4
+    #: nibbles per byte, with per-channel scales, dequantized in VMEM
+    #: inside the Pallas qmatmul. Halves (int8/fp8) or quarters (int4)
+    #: weight HBM; see ops/quantized_linear.py for the measured
+    #: speed tradeoffs
     weight_quant: Optional[str] = None
 
     @property

@@ -33,7 +33,7 @@ Params = Any
 _FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
               "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
               "phi", "phi3", "gpt_bigcode", "gptj", "bert", "distilbert",
-              "gpt_neo")
+              "gpt_neo", "internlm")
 
 
 def _map_hf_act(act: str) -> str:
@@ -121,6 +121,23 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             lm_head_bias=True,
             parallel_block=True, parallel_block_norms=1)
+    if mt == "internlm":
+        # llama math with "bias": true on all four attention projections
+        # (reference: module_inject/containers InternLMLayerPolicy); the
+        # generic llama-layout loader picks up the bias tensors
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads"),
+            intermediate_size=hf["intermediate_size"],
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="rmsnorm", activation="silu_glu", pos_emb="rope",
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+            use_bias=False, attn_bias=bool(hf.get("bias", True)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)))
     if mt == "gpt_neo":
         window = int(hf.get("window_size", 256))
         at = hf.get("attention_types") or \
@@ -700,11 +717,14 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         "wv": stackT(p + "self_attn.v_proj.weight"),
         "wo": stackT(p + "self_attn.o_proj.weight"),
     }
-    if p.format(0) + "self_attn.q_proj.bias" in names:   # qwen2
+    if p.format(0) + "self_attn.q_proj.bias" in names:   # qwen2/internlm
         attn["bq"] = stack(p + "self_attn.q_proj.bias")
         attn["bk"] = stack(p + "self_attn.k_proj.bias")
         attn["bv"] = stack(p + "self_attn.v_proj.bias")
-        attn["bo"] = np.zeros((L, cfg.hidden_size), dtype)
+        # internlm ("bias": true) also biases o_proj; qwen2 does not
+        attn["bo"] = stack(p + "self_attn.o_proj.bias") \
+            if p.format(0) + "self_attn.o_proj.bias" in names \
+            else np.zeros((L, cfg.hidden_size), dtype)
 
     layers: Dict[str, Any] = {
         "attn": attn,

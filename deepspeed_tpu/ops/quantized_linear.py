@@ -1,4 +1,5 @@
-"""Int8 / fp8-e4m3 weight-only quantized serving (Pallas dequant-in-VMEM matmul).
+"""Int8 / fp8-e4m3 / int4 weight-only quantized serving (Pallas
+dequant-in-VMEM matmul).
 
 Reference analogue: the weight-quantized inference linears
 (inference/quantization/ + module_inject/module_quantize.py and the
@@ -42,7 +43,7 @@ _E4M3_MAX = 448.0
 
 def quantize_weight(w: jax.Array, mode: str = "int8"
                     ) -> Tuple[jax.Array, jax.Array]:
-    """[K, N] float → (quantized [K, N], f32 scale [N]); symmetric
+    """[K, N] float → (quantized, f32 scale [N]); symmetric
     per-output-channel. Works on stacked [L, K, N] too (scale [L, N]).
 
     ``mode="int8"``: uniform 8-bit grid (scale = max|w|/127).
@@ -50,6 +51,13 @@ def quantize_weight(w: jax.Array, mode: str = "int8"
     byte width, but the exponent bits spend precision where weights
     cluster near zero; reference analogue: ops/fp_quantizer (FP6-LLM /
     fp8_gemm), here serving-only like the int8 path.
+    ``mode="int4"``: uniform 4-bit grid (scale = max|w|/7), TWO values
+    packed per uint8 byte → storage [K/2, N]: row r holds w[r] in the
+    low nibble and w[K/2 + r] in the high nibble (split-halves layout,
+    so the kernel reads one contiguous uint8 tile and two matching x
+    column tiles — no in-kernel interleave). Reference analogue: the
+    4-bit quantizer kernels under csrc/quantization (qwZ block quant)
+    and inference/quantization 4-bit serving.
     """
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
     if mode == "fp8":
@@ -57,13 +65,37 @@ def quantize_weight(w: jax.Array, mode: str = "int8"
         q = (w.astype(jnp.float32) / scale[..., None, :]).astype(
             jnp.float8_e4m3fn)
         return q, scale
+    if mode == "int4":
+        k = w.shape[-2]
+        if k % 2:
+            raise ValueError(f"int4 packing needs even K; got K={k}")
+        scale = jnp.maximum(absmax / 7.0, 1e-12)
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                     -7, 7).astype(jnp.int32)
+        lo = q[..., :k // 2, :] & 0xF
+        hi = q[..., k // 2:, :] & 0xF
+        return ((hi << 4) | lo).astype(jnp.uint8), scale
     scale = jnp.maximum(absmax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
                  -127, 127).astype(jnp.int8)
     return q, scale
 
 
+def _nibble(v: jax.Array) -> jax.Array:
+    """Sign-extend a 4-bit field held in the low bits of an int32."""
+    return (jnp.bitwise_xor(v & 0xF, 8) - 8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """packed uint8 [..., K/2, N] → int32 [..., K, N] (split-halves
+    inverse of quantize_weight mode='int4')."""
+    p = packed.astype(jnp.int32)
+    return jnp.concatenate([_nibble(p), _nibble(p >> 4)], axis=-2)
+
+
 def dequantize_weight(q: jax.Array, scale: jax.Array) -> jax.Array:
+    if q.dtype == jnp.uint8:   # int4 packed
+        return unpack_int4(q).astype(jnp.float32) * scale[..., None, :]
     return q.astype(jnp.float32) * scale[..., None, :]
 
 
@@ -115,11 +147,64 @@ def _qmm(x: jax.Array, w: jax.Array, scale: jax.Array, bm: int, bn: int,
     )(x, w, s2)
 
 
+def _qmm4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_ref, *,
+                 nk: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p = w_ref[...].astype(jnp.int32)
+    lo = _nibble(p).astype(jnp.bfloat16)        # rows [kk .. kk+bkp)
+    hi = _nibble(p >> 4).astype(jnp.bfloat16)   # rows [Kp+kk .. )
+    acc_ref[...] += lax.dot_general(
+        xlo_ref[...], lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] += lax.dot_general(
+        xhi_ref[...], hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
+
+
+def _qmm4(x: jax.Array, w_q: jax.Array, scale: jax.Array, bm: int, bn: int,
+          bkp: int, interpret: bool, out_dtype) -> jax.Array:
+    """int4 path: w_q [Kp, N] uint8 (Kp = K/2); x [M, K]."""
+    m, k = x.shape
+    kp, n = w_q.shape
+    nk = kp // bkp
+    s2 = scale.astype(jnp.float32).reshape(1, n)
+    kw = {}
+    if not interpret:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        functools.partial(_qmm4_kernel, nk=nk),
+        grid=(m // bm, n // bn, nk),
+        in_specs=[
+            # the same x is passed twice: low-half and high-half column
+            # tiles matching the packed row tile
+            pl.BlockSpec((bm, bkp), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bm, bkp), lambda i, j, kk, _nk=nk: (i, kk + _nk)),
+            pl.BlockSpec((bkp, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        **kw,
+    )(x, x, w_q, s2)
+
+
 def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
             out_dtype=None,
             interpret: Optional[bool] = None) -> jax.Array:
-    """x [M, K] (bf16/f32) @ int8-or-fp8 w_q [K, N] with per-channel
-    scale [N].
+    """x [M, K] (bf16/f32) @ quantized w_q with per-channel scale [N].
+    w_q: int8/fp8 [K, N], or int4-packed uint8 [K/2, N] (dtype-detected).
 
     Pads M up to a sublane multiple; falls back to an XLA dequant matmul
     off-TPU or for non-tileable K/N.
@@ -127,6 +212,27 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     m, k = x.shape
+    if w_q.dtype == jnp.uint8:   # int4 packed: [K/2, N]
+        kp, n = w_q.shape
+        if 2 * kp != k:
+            raise ValueError(
+                f"qmatmul(int4): packed rows {kp} != K/2 for x K={k}")
+        bkp = 512 if kp % 512 == 0 else (256 if kp % 256 == 0 else 0)
+        bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+        out_dtype = out_dtype or x.dtype
+        if not bkp or not bn:
+            logger.warning(
+                f"qmatmul(int4): K/2={kp}/N={n} not tileable; using XLA "
+                "dequant path")
+            w = unpack_int4(w_q).astype(jnp.float32) * scale[None, :]
+            return (x.astype(jnp.float32) @ w).astype(out_dtype)
+        mp = max(8, -(-m // 8) * 8)
+        bm = mp if mp <= 256 else 256
+        if mp % bm:
+            mp = -(-mp // bm) * bm
+        xp = x if mp == m else jnp.pad(x, ((0, mp - m), (0, 0)))
+        out = _qmm4(xp, w_q, scale, bm, bn, bkp, interpret, out_dtype)
+        return out[:m] if mp != m else out
     n = w_q.shape[1]
     bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
     bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
@@ -180,6 +286,8 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     g, m, k = x.shape
+    if w_q.dtype == jnp.uint8:   # int4 packed: [G, K/2, N]
+        return _qmm4_batched(x, w_q, scale, interpret, out_dtype)
     n = w_q.shape[2]
     bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
     bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
@@ -221,12 +329,86 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     return out[:, :m] if mp != m else out
 
 
+def _qmm4_batched_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_ref,
+                         *, nk: int):
+    k = pl.program_id(3)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p = w_ref[0].astype(jnp.int32)
+    lo = _nibble(p).astype(jnp.bfloat16)
+    hi = _nibble(p >> 4).astype(jnp.bfloat16)
+    acc_ref[...] += lax.dot_general(
+        xlo_ref[0], lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] += lax.dot_general(
+        xhi_ref[0], hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[...] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
+
+
+def _qmm4_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                  interpret: bool, out_dtype) -> jax.Array:
+    """Grouped int4 path: x [G, M, K] @ packed [G, K/2, N]."""
+    g, m, k = x.shape
+    kp, n = w_q.shape[1], w_q.shape[2]
+    if 2 * kp != k:
+        raise ValueError(
+            f"qmatmul_batched(int4): packed rows {kp} != K/2 for x K={k}")
+    bkp = 512 if kp % 512 == 0 else (256 if kp % 256 == 0 else 0)
+    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    out_dtype = out_dtype or x.dtype
+    if not bkp or not bn:
+        logger.warning(
+            f"qmatmul_batched(int4): K/2={kp}/N={n} not tileable; using "
+            "XLA dequant path (materializes fp32 expert weights)")
+        w = unpack_int4(w_q).astype(jnp.float32) * scale[:, None, :]
+        return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
+                          w).astype(out_dtype)
+    mp = max(8, -(-m // 8) * 8)
+    bm = mp if mp <= 256 else 256
+    if mp % bm:
+        mp = -(-mp // bm) * bm
+    xp = x if mp == m else jnp.pad(x, ((0, 0), (0, mp - m), (0, 0)))
+    nk = kp // bkp
+    s3 = scale.astype(jnp.float32).reshape(g, 1, n)
+    kw = {}
+    if not interpret:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_qmm4_batched_kernel, nk=nk),
+        grid=(g, mp // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((1, bm, bkp), lambda gg, i, j, kk: (gg, i, kk)),
+            pl.BlockSpec((1, bm, bkp),
+                         lambda gg, i, j, kk, _nk=nk: (gg, i, kk + _nk)),
+            pl.BlockSpec((1, bkp, bn), lambda gg, i, j, kk: (gg, kk, j)),
+            pl.BlockSpec((1, 1, bn), lambda gg, i, j, kk: (gg, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, bn),
+                               lambda gg, i, j, kk: (gg, i, j)),
+        out_shape=jax.ShapeDtypeStruct((g, mp, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        **kw,
+    )(xp, xp, w_q, s3)
+    return out[:, :m] if mp != m else out
+
+
 def validate_weight_quant(mode) -> None:
     """Shared early validation for the engines' ``weight_quant`` knob —
     fails before any parameter materialization."""
-    if mode is not None and mode not in ("int8", "fp8"):
+    if mode is not None and mode not in ("int8", "fp8", "int4"):
         raise ValueError(
-            f"weight_quant '{mode}' unsupported; expected 'int8' or 'fp8'")
+            f"weight_quant '{mode}' unsupported; expected 'int8', 'fp8' "
+            f"or 'int4'")
 
 
 def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
