@@ -35,9 +35,10 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--n-prompts", type=int, default=16)
     ap.add_argument("--no-pallas", action="store_true")
-    ap.add_argument("--quant", action="store_true",
-                    help="int8 weight-only serving (halves decode weight"
-                         " fetch)")
+    ap.add_argument("--quant", nargs="?", const="int8", default=None,
+                    choices=("int8", "fp8", "int4"),
+                    help="weight-only quantized serving (bare flag = "
+                         "int8; int4 quarters the decode weight fetch)")
     args = ap.parse_args()
 
     import jax
@@ -65,7 +66,7 @@ def main() -> None:
     new = args.new_tokens
 
     # ---- padded v1: one batch padded to the longest prompt
-    wq = "int8" if args.quant else None
+    wq = args.quant
     v1 = init_inference(model, {"dtype": dtype, "weight_quant": wq},
                         params=params, rng=jax.random.PRNGKey(0))
     width = int(max(lens))
@@ -94,7 +95,7 @@ def main() -> None:
     result = {
         "metric": f"ragged vs padded decode llama3-{size} "
                   f"{args.n_prompts} mixed-length prompts"
-                  + (" int8" if args.quant else ""),
+                  + (f" {args.quant}" if args.quant else ""),
         "value": round(gen_tokens / t_ragged, 2),
         "unit": "gen tokens/s (ragged)",
         "vs_baseline": round(t_padded / t_ragged, 4),

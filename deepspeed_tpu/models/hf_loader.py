@@ -286,7 +286,10 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
         pos_emb="rope",
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
-        use_bias=(mt in ("qwen2", "qwen2_moe")),   # qkv bias only
+        # qwen2: qkv bias only; llama attention_bias=true (the InternLM
+        # round-trip layout): biases on all four attention projections
+        use_bias=(mt in ("qwen2", "qwen2_moe")
+                  or bool(hf.get("attention_bias", False))),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
     # HF semantics differ per family: Mistral applies sliding_window
@@ -582,6 +585,12 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         mt, arch = "qwen2_moe", ["Qwen2MoeForCausalLM"]
     elif cfg.num_experts:
         mt, arch = "mixtral", ["MixtralForCausalLM"]
+    elif cfg.qkv_bias and cfg.out_bias and not cfg.use_bias \
+            and cfg.sliding_window is None:
+        # InternLM shape: biases on all four attention projections but
+        # nowhere else — LlamaConfig expresses it exactly via
+        # attention_bias=true (o_proj bias INCLUDED, unlike qwen2)
+        mt, arch = "llama", ["LlamaForCausalLM"]
     elif cfg.use_bias:
         # qkv biases exist only in the qwen2 layout of this family;
         # exporting as llama/mistral would silently drop them
@@ -607,6 +616,8 @@ def config_to_hf(cfg: DecoderConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "torch_dtype": "float32",
     }
+    if mt == "llama" and cfg.qkv_bias:
+        hf["attention_bias"] = True   # InternLM round-trip
     if cfg.sliding_window is not None:
         hf["sliding_window"] = cfg.sliding_window
         if mt == "qwen2":
@@ -1473,12 +1484,15 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
             np.ascontiguousarray(a["wv"][i].T)
         out[p.format(i) + "self_attn.o_proj.weight"] = \
             np.ascontiguousarray(a["wo"][i].T)
-        if "bq" in a:   # qwen2: qkv biases; the HF layout has NO o_proj
-            # bias slot, so a trained nonzero bo cannot round-trip
+        if "bq" in a:
             out[p.format(i) + "self_attn.q_proj.bias"] = a["bq"][i]
             out[p.format(i) + "self_attn.k_proj.bias"] = a["bk"][i]
             out[p.format(i) + "self_attn.v_proj.bias"] = a["bv"][i]
-            if np.abs(a["bo"][i]).max() > 1e-6:
+            if cfg_hf.get("attention_bias"):
+                # llama attention_bias layout (InternLM): o_proj bias
+                # has a real slot
+                out[p.format(i) + "self_attn.o_proj.bias"] = a["bo"][i]
+            elif np.abs(a["bo"][i]).max() > 1e-6:
                 logger.warning(
                     "export_hf_checkpoint: layer %d o_proj bias is "
                     "nonzero but the qwen2 HF layout has no slot for it "

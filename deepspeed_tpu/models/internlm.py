@@ -3,10 +3,9 @@ InternLMLayerPolicy / DS_InternLMContainer).
 
 Llama math (RMSNorm, RoPE, SwiGLU) with ``"bias": true`` — all four
 attention projections carry biases (o_proj included, unlike Qwen2).
-Export note: HF-library layouts have no slot for a biased o_proj, so a
-trained nonzero bo exports via the qwen2 layout with a warning
-(hf_loader export path); loading the original InternLM checkpoint is
-exact.
+Exports exactly as ``model_type: llama`` with ``attention_bias: true``
+(the LlamaConfig slot covering o_proj bias), so trained InternLM
+checkpoints round-trip through transformers without loss.
 """
 
 from deepspeed_tpu.models.transformer import DecoderConfig

@@ -19,6 +19,11 @@ What this buys on TPU — measured honestly on v5e (1.27B llama, batch
   `dot(x, w_int8.astype(bf16))` materializes the dequantized weight
   (0.71x). A future >2x win needs int8 DMA to outpace bf16 — revisit
   per libtpu generation.
+- **int4**: quarter the weight HBM; end-to-end serving measured
+  slightly FASTER than bf16 on v5e (bench_inference, 1B llama, 8
+  mixed prompts, 32 new tokens: padded 870 vs 831 tok/s, ragged 700
+  vs 606) — the nibble unpack is free next to the halved weight DMA.
+  15-level grid though: validate task quality before shipping int4.
 """
 
 import functools
@@ -97,6 +102,26 @@ def dequantize_weight(q: jax.Array, scale: jax.Array) -> jax.Array:
     if q.dtype == jnp.uint8:   # int4 packed
         return unpack_int4(q).astype(jnp.float32) * scale[..., None, :]
     return q.astype(jnp.float32) * scale[..., None, :]
+
+
+def _tile(dim: int) -> int:
+    """Largest supported block size dividing ``dim`` (0 = not tileable)."""
+    return 512 if dim % 512 == 0 else (256 if dim % 256 == 0 else 0)
+
+
+def _pad_m(x: jax.Array, m: int, axis: int):
+    """Pad the M (rows) axis up to a sublane multiple; returns
+    (padded x, padded m, block m). Shared by all four kernel wrappers so
+    a tiling tweak can't silently diverge between them."""
+    mp = max(8, -(-m // 8) * 8)
+    bm = mp if mp <= 256 else 256
+    if mp % bm:
+        mp = -(-mp // bm) * bm
+    if mp == m:
+        return x, mp, bm
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mp - m)
+    return jnp.pad(x, pad), mp, bm
 
 
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
@@ -217,8 +242,7 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         if 2 * kp != k:
             raise ValueError(
                 f"qmatmul(int4): packed rows {kp} != K/2 for x K={k}")
-        bkp = 512 if kp % 512 == 0 else (256 if kp % 256 == 0 else 0)
-        bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+        bkp, bn = _tile(kp), _tile(n)
         out_dtype = out_dtype or x.dtype
         if not bkp or not bn:
             logger.warning(
@@ -226,27 +250,18 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
                 "dequant path")
             w = unpack_int4(w_q).astype(jnp.float32) * scale[None, :]
             return (x.astype(jnp.float32) @ w).astype(out_dtype)
-        mp = max(8, -(-m // 8) * 8)
-        bm = mp if mp <= 256 else 256
-        if mp % bm:
-            mp = -(-mp // bm) * bm
-        xp = x if mp == m else jnp.pad(x, ((0, mp - m), (0, 0)))
+        xp, mp, bm = _pad_m(x, m, 0)
         out = _qmm4(xp, w_q, scale, bm, bn, bkp, interpret, out_dtype)
         return out[:m] if mp != m else out
     n = w_q.shape[1]
-    bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
-    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    bk, bn = _tile(k), _tile(n)
     out_dtype = out_dtype or x.dtype
     if not bk or not bn:
         logger.warning(
             f"qmatmul: K={k}/N={n} not tileable; using XLA dequant path")
         w = w_q.astype(jnp.float32) * scale[None, :]
         return (x.astype(jnp.float32) @ w).astype(out_dtype)
-    mp = max(8, -(-m // 8) * 8)
-    bm = mp if mp <= 256 else 256
-    if mp % bm:
-        mp = -(-mp // bm) * bm
-    xp = x if mp == m else jnp.pad(x, ((0, mp - m), (0, 0)))
+    xp, mp, bm = _pad_m(x, m, 0)
     out = _qmm(xp, w_q, scale, bm, bn, bk, interpret, out_dtype)
     return out[:m] if mp != m else out
 
@@ -289,8 +304,7 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if w_q.dtype == jnp.uint8:   # int4 packed: [G, K/2, N]
         return _qmm4_batched(x, w_q, scale, interpret, out_dtype)
     n = w_q.shape[2]
-    bk = 512 if k % 512 == 0 else (256 if k % 256 == 0 else 0)
-    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    bk, bn = _tile(k), _tile(n)
     out_dtype = out_dtype or x.dtype
     if not bk or not bn:
         logger.warning(
@@ -300,11 +314,7 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         w = w_q.astype(jnp.float32) * scale[:, None, :]
         return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
                           w).astype(out_dtype)
-    mp = max(8, -(-m // 8) * 8)
-    bm = mp if mp <= 256 else 256
-    if mp % bm:
-        mp = -(-mp // bm) * bm
-    xp = x if mp == m else jnp.pad(x, ((0, 0), (0, mp - m), (0, 0)))
+    xp, mp, bm = _pad_m(x, m, 1)
     nk = k // bk
     s3 = scale.astype(jnp.float32).reshape(g, 1, n)
     kw = {}
@@ -360,8 +370,7 @@ def _qmm4_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if 2 * kp != k:
         raise ValueError(
             f"qmatmul_batched(int4): packed rows {kp} != K/2 for x K={k}")
-    bkp = 512 if kp % 512 == 0 else (256 if kp % 256 == 0 else 0)
-    bn = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 0)
+    bkp, bn = _tile(kp), _tile(n)
     out_dtype = out_dtype or x.dtype
     if not bkp or not bn:
         logger.warning(
@@ -370,11 +379,7 @@ def _qmm4_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         w = unpack_int4(w_q).astype(jnp.float32) * scale[:, None, :]
         return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
                           w).astype(out_dtype)
-    mp = max(8, -(-m // 8) * 8)
-    bm = mp if mp <= 256 else 256
-    if mp % bm:
-        mp = -(-mp // bm) * bm
-    xp = x if mp == m else jnp.pad(x, ((0, 0), (0, mp - m), (0, 0)))
+    xp, mp, bm = _pad_m(x, m, 1)
     nk = kp // bkp
     s3 = scale.astype(jnp.float32).reshape(g, 1, n)
     kw = {}

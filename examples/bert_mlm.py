@@ -1,0 +1,79 @@
+"""Masked-LM fine-tuning for BERT-class encoders (the reference's
+encoder path: module_inject/containers/bert.py served encoders; the
+1-bit Adam benchmarks were BERT pretraining).
+
+    # random-init BERT-base, synthetic data, 2-way data parallel
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/bert_mlm.py --steps 20
+
+    # or fine-tune a real HF checkpoint
+    python examples/bert_mlm.py --model-dir /path/to/hf_bert --steps 20
+
+The batch contract for encoders: ``input_ids`` (with [MASK]
+corruptions), ``labels`` (-100 everywhere except masked positions),
+optional ``attention_mask`` (1 = real, 0 = pad — correctness-critical
+for bidirectional attention) and ``token_type_ids``.
+"""
+
+import argparse
+
+import numpy as np
+
+from _common import setup_jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-dir", default=None,
+                    help="HF BERT/DistilBERT dir; default random init")
+    ap.add_argument("--size", default="base",
+                    help="preset when no --model-dir (tiny|base|large)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--mask-prob", type=float, default=0.15)
+    ap.add_argument("--zero-stage", type=int, default=2)
+    args = ap.parse_args()
+
+    jax = setup_jax()
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    params = None
+    if args.model_dir:
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+        cfg, params = load_hf_checkpoint(args.model_dir)
+        params = jax.tree.map(jnp.asarray, params)
+    else:
+        from deepspeed_tpu.models import bert_config
+        cfg = bert_config(args.size, max_seq_len=args.seq)
+
+    n = min(2, len(jax.devices()))
+    build_mesh(data=n, devices=jax.devices()[:n])
+    engine, _, _, _ = ds.initialize(
+        model=cfg, params=params,
+        config={"train_micro_batch_size_per_gpu": args.batch // n,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": args.zero_stage}},
+        rng=jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    mask_id = 103 if cfg.vocab_size > 103 else 0   # BERT [MASK]
+    for step in range(args.steps):
+        tokens = rng.integers(1000 if cfg.vocab_size > 2000 else 1,
+                              cfg.vocab_size,
+                              size=(args.batch, args.seq), dtype=np.int32)
+        labels = np.full_like(tokens, -100)
+        m = rng.random(tokens.shape) < args.mask_prob
+        labels[m] = tokens[m]
+        corrupted = tokens.copy()
+        corrupted[m] = mask_id
+        loss = engine.train_batch(iter([{"input_ids": corrupted,
+                                         "labels": labels}]))
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step}: mlm_loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,10 @@ def main():
     ap.add_argument("--prompts", nargs="+", default=["1 2 3 4"])
     ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--weight-quant", choices=("int8", "fp8", "int4"),
+                    default=None,
+                    help="weight-only quantized serving (half or quarter "
+                         "the weight HBM; ops/quantized_linear.py)")
     args = ap.parse_args()
 
     from _common import setup_jax
@@ -43,14 +47,17 @@ def main():
         return [int(x) % cfg.vocab_size for x in p.split()]
 
     prompts = [encode(p) for p in args.prompts]
+    eng_cfg = {}
+    if args.weight_quant:
+        eng_cfg["weight_quant"] = args.weight_quant
     if args.engine == "ragged":
         from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
-        eng = RaggedInferenceEngineTPU(cfg, params=params)
+        eng = RaggedInferenceEngineTPU(cfg, eng_cfg or None, params=params)
         outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens,
                             temperature=args.temperature)
     else:
         from deepspeed_tpu.inference.engine import InferenceEngineTPU
-        eng = InferenceEngineTPU(cfg, params=params)
+        eng = InferenceEngineTPU(cfg, eng_cfg or None, params=params)
         outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens,
                             temperature=args.temperature)
     for p, o in zip(args.prompts, outs):

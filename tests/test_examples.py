@@ -45,6 +45,20 @@ def test_serve_v1_example():
     assert "1 2 3" in r.stdout
 
 
+def test_serve_int4_example():
+    r = _run(["examples/serve.py", "--engine", "v1", "--prompts", "1 2 3",
+              "--max-new-tokens", "4", "--weight-quant", "int4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "1 2 3" in r.stdout
+
+
+def test_bert_mlm_example():
+    r = _run(["examples/bert_mlm.py", "--steps", "4", "--seq", "64",
+              "--batch", "4", "--size", "tiny"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "mlm_loss" in r.stdout
+
+
 def test_finetune_hf_example(tmp_path):
     import torch
     from transformers import LlamaConfig, LlamaForCausalLM

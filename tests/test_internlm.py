@@ -63,6 +63,32 @@ def test_internlm_logits_parity(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+def test_internlm_export_roundtrip(tmp_path):
+    """InternLM-shaped configs export as llama + attention_bias=true —
+    o_proj bias INCLUDED — and transformers reloads to identical logits
+    (regression: the export once silently dropped all attention
+    biases)."""
+    from deepspeed_tpu.models.hf_loader import (config_from_hf,
+                                                export_hf_checkpoint)
+    hf_model, model_dir = _tiny_internlm_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    out_dir = str(tmp_path / "export")
+    export_hf_checkpoint(cfg, jax.tree.map(jnp.asarray, params), out_dir)
+    with open(os.path.join(out_dir, "config.json")) as fh:
+        exported = json.load(fh)
+    assert exported["model_type"] == "llama"
+    assert exported["attention_bias"] is True
+    reloaded = LlamaForCausalLM.from_pretrained(out_dir).eval()
+    tokens = torch.arange(1, 13, dtype=torch.long)[None]
+    with torch.no_grad():
+        np.testing.assert_allclose(reloaded(tokens).logits.numpy(),
+                                   hf_model(tokens).logits.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+    # and OUR loader honors llama attention_bias on the way back in
+    cfg2 = config_from_hf(exported)
+    assert cfg2.qkv_bias and cfg2.out_bias
+
+
 def test_internlm_preset_trains():
     cfg = internlm_config("tiny")
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
