@@ -1,16 +1,21 @@
 """Inference engines: v1 padded KV-cache generation
-(:mod:`deepspeed_tpu.inference.engine`) and the ragged paged-KV engine
-(:mod:`deepspeed_tpu.inference.engine_v2`, the FastGen-core analogue)."""
+(:mod:`deepspeed_tpu.inference.engine`), the ragged paged-KV engine
+(:mod:`deepspeed_tpu.inference.engine_v2`, the FastGen-core analogue),
+and the encoder scoring engine
+(:mod:`deepspeed_tpu.inference.encoder`, the BERT-container analogue)."""
 
 from deepspeed_tpu.inference.engine import (DeepSpeedTPUInferenceConfig,
                                             InferenceEngineTPU,
                                             init_inference)
 from deepspeed_tpu.inference.engine_v2 import (RaggedInferenceConfig,
                                                RaggedInferenceEngineTPU)
+from deepspeed_tpu.inference.encoder import (EncoderInferenceTPU,
+                                             init_encoder_inference)
 from deepspeed_tpu.inference.ragged import (BlockedAllocator, DSStateManager,
                                             RaggedScheduler)
 
 __all__ = ["init_inference", "InferenceEngineTPU",
            "DeepSpeedTPUInferenceConfig", "RaggedInferenceEngineTPU",
-           "RaggedInferenceConfig", "BlockedAllocator", "DSStateManager",
+           "RaggedInferenceConfig", "EncoderInferenceTPU",
+           "init_encoder_inference", "BlockedAllocator", "DSStateManager",
            "RaggedScheduler"]

@@ -1,0 +1,153 @@
+"""Encoder (BERT-class) serving engine.
+
+Reference analogue: DeepSpeed v1 inference served encoders through the
+kernel-injection containers (module_inject/containers/bert.py,
+distil_bert.py) — batched scoring, no decode loop. Here the engine owns
+the same concerns as `InferenceEngineTPU` minus the KV cache: TP-aware
+parameter sharding (GSPMD from `partition_specs`), dtype policy,
+weight-only quantization, and shape-bucketed jit so variable-length
+batches reuse compiles.
+
+Padding is handled INSIDE the engine: inputs are padded to (batch
+bucket, 64·k sequence bucket) and a key mask covers the pad — callers
+can pass ragged python lists and correctness does not depend on them
+building the attention_mask themselves (for padded bidirectional
+attention the mask is correctness-critical, not an optimization).
+"""
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.models.transformer import (DecoderConfig, forward,
+                                              forward_hidden)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class EncoderInferenceTPU:
+    """Batched encoder scoring: ``__call__`` returns MLM logits (or
+    final hidden states) for right-padded batches of any length."""
+
+    _SEQ_BUCKET = 64
+
+    def __init__(self, model: DecoderConfig,
+                 config: Union[Dict[str, Any], None] = None,
+                 params=None, rng: Optional[jax.Array] = None,
+                 mesh=None):
+        from deepspeed_tpu.inference.engine import DeepSpeedTPUInferenceConfig
+        if isinstance(config, dict) or config is None:
+            config = DeepSpeedTPUInferenceConfig(**(config or {}))
+        if model.causal:
+            raise ValueError(
+                "EncoderInferenceTPU is for bidirectional (causal=False) "
+                "models; use InferenceEngineTPU / the ragged engine for "
+                "decoder models")
+        self.model_config = model
+        self.config = config
+        from deepspeed_tpu.inference.engine import setup_engine_params
+        self.mesh, self.dtype, self.params, self._param_sh = \
+            setup_engine_params(model, config, mesh, params, rng)
+        self._data_sh = NamedSharding(
+            self.mesh, P(("data", "data_inner", "expert"), None))
+        self._fns: Dict[Any, Any] = {}
+        log_dist(f"encoder engine ready: tp={self.mesh.shape['model']} "
+                 f"dtype={config.dtype}")
+
+    def _fn(self, b: int, t: int, hidden: bool):
+        key = (b, t, hidden)
+        if key not in self._fns:
+            cfg = self.model_config
+
+            def run(params, tokens, mask, types):
+                if hidden:
+                    out, _ = forward_hidden(cfg, params, tokens,
+                                            token_type_ids=types,
+                                            attention_mask=mask)
+                    return out
+                return forward(cfg, params, tokens, token_type_ids=types,
+                               attention_mask=mask)
+
+            self._fns[key] = jax.jit(run)
+        return self._fns[key]
+
+    def __call__(self, input_ids: Union[np.ndarray, Sequence[Sequence[int]]],
+                 attention_mask: Optional[np.ndarray] = None,
+                 token_type_ids: Optional[np.ndarray] = None,
+                 output: str = "logits") -> List[np.ndarray]:
+        """Score a batch. ``input_ids``: [B, T] array OR a ragged list of
+        token lists (engine right-pads + masks). Returns a list of B
+        arrays, each trimmed to its true length: [t_i, V] logits
+        (``output='logits'``) or [t_i, D] hidden (``output='hidden'``).
+        """
+        if output not in ("logits", "hidden"):
+            raise ValueError(f"output must be 'logits'|'hidden', "
+                             f"got '{output}'")
+        ragged = not isinstance(input_ids, np.ndarray)
+        if ragged:
+            lens = [len(s) for s in input_ids]
+            tmax = max(lens)
+            ids = np.zeros((len(lens), tmax), np.int32)
+            mask = np.zeros((len(lens), tmax), np.int32)
+            for i, s in enumerate(input_ids):
+                ids[i, :len(s)] = np.asarray(s, np.int32)
+                if attention_mask is not None:
+                    # honor a caller mask row-by-row (a sequence may
+                    # itself contain pad tokens the caller masks out)
+                    mask[i, :len(s)] = np.asarray(attention_mask[i],
+                                                  np.int32)[:len(s)]
+                else:
+                    mask[i, :len(s)] = 1
+            # lens stay the GIVEN sequence lengths: outputs are trimmed
+            # to what the caller passed, masked-out positions included
+            # (an interior pad still occupies its slot)
+            if token_type_ids is not None:
+                tt = np.zeros((len(lens), tmax), np.int32)
+                for i, s in enumerate(token_type_ids):
+                    tt[i, :len(s)] = np.asarray(s, np.int32)
+                token_type_ids = tt
+            input_ids, attention_mask = ids, mask
+        else:
+            input_ids = np.asarray(input_ids, np.int32)
+            lens = [input_ids.shape[1]] * input_ids.shape[0] \
+                if attention_mask is None else \
+                [int(m.sum()) for m in np.asarray(attention_mask)]
+        b, t = input_ids.shape
+        if t > self.model_config.max_seq_len:
+            raise ValueError(f"sequence length {t} exceeds model "
+                             f"max_seq_len {self.model_config.max_seq_len}")
+
+        # bucket shapes so variable-length batches share compiles
+        tb = min(-(-t // self._SEQ_BUCKET) * self._SEQ_BUCKET,
+                 self.model_config.max_seq_len)
+        bb = 1 << (b - 1).bit_length()
+        dp = (self.mesh.shape["data"] * self.mesh.shape["data_inner"]
+              * self.mesh.shape["expert"])
+        bb = -(-bb // dp) * dp
+        ids = np.zeros((bb, tb), np.int32)
+        ids[:b, :t] = input_ids
+        mask = np.zeros((bb, tb), np.int32)
+        if attention_mask is not None:
+            mask[:b, :t] = np.asarray(attention_mask, np.int32)
+        else:
+            mask[:b, :t] = 1
+        types = np.zeros((bb, tb), np.int32)
+        if token_type_ids is not None:
+            types[:b, :t] = np.asarray(token_type_ids, np.int32)
+
+        put = partial(jax.device_put, device=self._data_sh)
+        out = self._fn(bb, tb, output == "hidden")(
+            self.params, put(jnp.asarray(ids)), put(jnp.asarray(mask)),
+            put(jnp.asarray(types)))
+        out = np.asarray(out)
+        return [out[i, :lens[i]] for i in range(b)]
+
+
+def init_encoder_inference(model: DecoderConfig, config=None, **kw
+                           ) -> EncoderInferenceTPU:
+    """Parity-named constructor (reference ``deepspeed.init_inference``
+    routed encoders through the same entrypoint)."""
+    return EncoderInferenceTPU(model, config, **kw)

@@ -54,6 +54,53 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
         return int(self.tensor_parallel.get("tp_size", 1) or 1)
 
 
+def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
+    """Shared serving-engine bring-up (v1 generator + encoder engine):
+    mesh resolution, dtype policy, TP/EP weight-quant guards, GSPMD
+    sharding from ``partition_specs``, init-or-device_put with dtype
+    cast, and weight-only quantization. Returns
+    ``(mesh, dtype, params, param_shardings)``."""
+    from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
+    validate_weight_quant(config.weight_quant)
+    if mesh is None:
+        mesh = get_mesh() if has_mesh() else build_mesh(model=config.tp_size)
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[config.dtype]
+    tp = mesh.shape["model"] > 1
+    if config.weight_quant and tp:
+        raise ValueError(
+            f"weight_quant={config.weight_quant} requires tp_size=1 / a "
+            "mesh with model axis 1 (quantized leaves are not TP-sharded)")
+    if config.weight_quant and model.num_experts and \
+            mesh.shape["expert"] > 1:
+        raise ValueError(
+            f"weight_quant={config.weight_quant} requires an expert "
+            "mesh axis of 1: GSPMD replicates the opaque grouped "
+            "dequant kernel, silently losing both the EP sharding and "
+            "the memory halving — quantized MoE serving is a "
+            "single-chip capacity feature (same precedent as the TP "
+            "restriction above)")
+    specs = partition_specs(model, zero_stage=0, tp=tp)
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def cast(x):
+        return x.astype(dtype) \
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    if params is None:
+        init = jax.jit(lambda r: jax.tree.map(cast, init_params(model, r)),
+                       out_shardings=param_sh)
+        params = init(rng)
+    else:
+        params = jax.device_put(jax.tree.map(cast, params), param_sh)
+    if config.weight_quant:
+        from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+        params = quantize_param_tree(params, mode=config.weight_quant)
+    return mesh, dtype, params, param_sh
+
+
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
             top_k: int, top_p: float) -> jax.Array:
     """logits [B, V] → token ids [B]."""
@@ -86,61 +133,15 @@ class InferenceEngineTPU:
             raise ValueError(
                 "InferenceEngineTPU generates autoregressively; "
                 "encoder (bidirectional) models have no decode loop — "
-                "run transformer.forward directly for BERT-class models")
+                "use EncoderInferenceTPU for BERT-class models")
         self.model_config = model
         self.config = config
-        from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
-        validate_weight_quant(config.weight_quant)
-        if mesh is not None:
-            self.mesh = mesh
-        elif has_mesh():
-            self.mesh = get_mesh()
-        else:
-            self.mesh = build_mesh(model=config.tp_size)
-        self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-                      "float16": jnp.float16}[config.dtype]
-
-        tp = self.mesh.shape["model"] > 1
-        if config.weight_quant and tp:
-            raise ValueError(f"weight_quant={config.weight_quant} requires tp_size=1 / a "
-                             "mesh with model axis 1 (quantized leaves "
-                             "are not TP-sharded)")
-        if config.weight_quant and model.num_experts and \
-                self.mesh.shape["expert"] > 1:
-            raise ValueError(
-                f"weight_quant={config.weight_quant} requires an expert "
-                "mesh axis of 1: GSPMD replicates the opaque grouped "
-                "dequant kernel, silently losing both the EP sharding and "
-                "the memory halving — quantized MoE serving is a "
-                "single-chip capacity feature (same precedent as the TP "
-                "restriction above)")
-        specs = partition_specs(model, zero_stage=0, tp=tp)
-        self._param_sh = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P))
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
-        if params is None:
-            init = jax.jit(
-                lambda r: jax.tree.map(
-                    lambda x: x.astype(self.dtype)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                    init_params(model, r)),
-                out_shardings=self._param_sh)
-            self.params = init(rng)
-        else:
-            self.params = jax.device_put(
-                jax.tree.map(lambda x: x.astype(self.dtype)
-                             if jnp.issubdtype(x.dtype, jnp.floating)
-                             else x, params),
-                self._param_sh)
-
-        if config.weight_quant:
-            from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
-            self.params = quantize_param_tree(self.params,
-                                              mode=config.weight_quant)
+        self.mesh, self.dtype, self.params, self._param_sh = \
+            setup_engine_params(model, config, mesh, params, rng)
 
         # KV cache sharded over batch (DP axes) and kv heads (model axis
         # when divisible)
+        tp = self.mesh.shape["model"] > 1
         kv_h = "model" if (tp and model.kv_heads % self.mesh.shape["model"]
                            == 0) else None
         self._cache_sh = NamedSharding(

@@ -286,10 +286,12 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
         pos_emb="rope",
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
-        # qwen2: qkv bias only; llama attention_bias=true (the InternLM
-        # round-trip layout): biases on all four attention projections
-        use_bias=(mt in ("qwen2", "qwen2_moe")
-                  or bool(hf.get("attention_bias", False))),
+        # qwen2: qkv bias only (use_bias); llama attention_bias=true (the
+        # InternLM round-trip layout): biases on all four attention
+        # projections via attn_bias — NOT use_bias, so the config
+        # re-exports through the same llama+attention_bias branch
+        use_bias=(mt in ("qwen2", "qwen2_moe")),
+        attn_bias=True if bool(hf.get("attention_bias", False)) else None,
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
     # HF semantics differ per family: Mistral applies sliding_window

@@ -84,9 +84,14 @@ def test_internlm_export_roundtrip(tmp_path):
         np.testing.assert_allclose(reloaded(tokens).logits.numpy(),
                                    hf_model(tokens).logits.numpy(),
                                    rtol=1e-5, atol=1e-5)
-    # and OUR loader honors llama attention_bias on the way back in
+    # and OUR loader honors llama attention_bias on the way back in,
+    # producing a config that RE-exports through the same branch (a
+    # use_bias=True mapping would silently degrade to qwen2 and drop bo)
+    from deepspeed_tpu.models.hf_loader import config_to_hf
     cfg2 = config_from_hf(exported)
-    assert cfg2.qkv_bias and cfg2.out_bias
+    assert cfg2.qkv_bias and cfg2.out_bias and not cfg2.use_bias
+    hf2 = config_to_hf(cfg2)
+    assert hf2["model_type"] == "llama" and hf2["attention_bias"] is True
 
 
 def test_internlm_preset_trains():
