@@ -36,7 +36,7 @@ def main() -> None:
     ap.add_argument("--n-prompts", type=int, default=16)
     ap.add_argument("--no-pallas", action="store_true")
     ap.add_argument("--quant", nargs="?", const="int8", default=None,
-                    choices=("int8", "fp8", "int4"),
+                    choices=("int8", "fp8", "int4", "fp6"),
                     help="weight-only quantized serving (bare flag = "
                          "int8; int4 quarters the decode weight fetch)")
     args = ap.parse_args()

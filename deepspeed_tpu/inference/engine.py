@@ -41,12 +41,12 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
     max_batch_size: int = 8
     replace_with_kernel_inject: bool = False   # parity no-op: jit fuses
     min_out_tokens: int = 1
-    #: "int8" | "fp8" | "int4" = weight-only quantized serving: matmul
-    #: weights stored int8 (uniform grid), float8_e4m3fn, or two int4
-    #: nibbles per byte, with per-channel scales, dequantized in VMEM
-    #: inside the Pallas qmatmul. Halves (int8/fp8) or quarters (int4)
-    #: weight HBM; see ops/quantized_linear.py for the measured
-    #: speed tradeoffs
+    #: "int8" | "fp8" | "int4" | "fp6" = weight-only quantized serving:
+    #: matmul weights stored int8 (uniform grid), float8_e4m3fn, two
+    #: int4 nibbles per byte, or four fp6-e3m2 values per three bytes,
+    #: with per-channel scales, dequantized in VMEM inside the Pallas
+    #: qmatmul kernels. Weight HBM vs bf16: 1/2 (int8/fp8), 3/8 (fp6),
+    #: 1/4 (int4); see ops/quantized_linear.py for measured tradeoffs
     weight_quant: Optional[str] = None
 
     @property

@@ -48,7 +48,7 @@ class RaggedInferenceConfig(TPUConfigModel):
     max_batch_tokens: int = 2048     #: scheduler token budget per step
     prefill_chunk: int = 256         #: SplitFuse chunk width
     use_pallas: Optional[bool] = None  #: None = auto (TPU only)
-    weight_quant: Optional[str] = None  #: "int8"|"fp8"|"int4" weight-only
+    weight_quant: Optional[str] = None  #: "int8"|"fp8"|"int4"|"fp6" weight-only
 
 
 def ragged_forward(cfg: DecoderConfig, params, arena, tokens: jax.Array,

@@ -64,6 +64,58 @@ def test_quantize_roundtrip_int4():
     assert qs.shape == (3, 32, 128) and ss.shape == (3, 128)
 
 
+def test_quantize_roundtrip_fp6():
+    """e3m2 invariants: storage [3, K/4, N] uint8 (0.75 bytes/weight),
+    per-element error <= max(|w|/8, scale·2^-5) (2 mantissa bits →
+    half-step 1/8 relative in the normal range, absolute on the
+    subnormal grid)."""
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.normal(size=(256, 512)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w, mode="fp6")
+    assert q.dtype == jnp.uint8 and q.shape == (3, 64, 512)
+    assert s.shape == (512,)
+    back = np.asarray(dequantize_weight(q, s))
+    wn = np.asarray(w)
+    sn = np.asarray(s)
+    bound = np.maximum(np.abs(wn) / 8, sn[None, :] * 2.0 ** -5) + 1e-8
+    assert (np.abs(back - wn) <= bound).all()
+    # fp6 must beat int4 accuracy on gaussian weights (more levels near
+    # zero, where weights cluster)
+    q4, s4 = quantize_weight(w, mode="int4")
+    back4 = np.asarray(dequantize_weight(q4, s4))
+    assert np.linalg.norm(back - wn) < np.linalg.norm(back4 - wn)
+    # stacked
+    ws = jnp.asarray(rng.normal(size=(3, 64, 128)), jnp.float32)
+    qs, ss = quantize_weight(ws, mode="fp6")
+    assert qs.shape == (3, 3, 16, 128) and ss.shape == (3, 128)
+
+
+def test_qmatmul_fp6_kernel_matches_dequant_reference():
+    """K=2048 → K/4=512: tileable, drives the real Pallas fp6 kernel
+    (4-plane unpack + e3m2 decode) under the interpreter."""
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(size=(16, 2048)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2048, 512)) * 0.05, jnp.float32)
+    q, s = quantize_weight(w, mode="fp6")
+    ref = x @ dequantize_weight(q, s)
+    out = qmatmul(x, q, s, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_qmatmul_batched_fp6_matches_dequant_reference():
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(2, 8, 2048)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, 2048, 512)) * 0.05, jnp.float32)
+    from deepspeed_tpu.ops.quantized_linear import qmatmul_batched
+    q, s = quantize_weight(w, mode="fp6")
+    assert q.shape == (2, 3, 512, 512)
+    out = qmatmul_batched(x, q, s, interpret=True)
+    ref = jnp.einsum("gmk,gkn->gmn", x, dequantize_weight(q, s))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_qmatmul_int4_kernel_matches_dequant_reference():
     """K=512 → packed 256: tileable, so this drives the actual Pallas
     int4 kernel (interpret mode) rather than the XLA fallback."""
@@ -107,7 +159,7 @@ def _logits(cfg, params, tokens):
                                           jnp.asarray(tokens)))
 
 
-@pytest.mark.parametrize("mode", ["int8", "fp8", "int4"])
+@pytest.mark.parametrize("mode", ["int8", "fp8", "int4", "fp6"])
 def test_quantized_forward_close_to_float(devices, mode):
     """Whole-model check: weight-only quantized logits stay close to the
     float model (the near-lossless claim, and the wiring through
@@ -119,7 +171,7 @@ def test_quantized_forward_close_to_float(devices, mode):
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     qp = quantize_param_tree(params, mode=mode)
     expect_dt = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn,
-                 "int4": jnp.uint8}[mode]
+                 "int4": jnp.uint8, "fp6": jnp.uint8}[mode]
     assert qp["layers"]["attn"]["wq"].dtype == expect_dt
     assert "lm_head_q" in qp                      # tied → transposed copy
 
@@ -130,13 +182,14 @@ def test_quantized_forward_close_to_float(devices, mode):
     # fp8 (3 mantissa bits) is a coarser grid than per-channel int8;
     # int4 (15 levels) is coarser still
     cos_min, rel_max = {"int8": (0.999, 0.05), "fp8": (0.997, 0.09),
-                        "int4": (0.98, 0.25)}[mode]
+                        "int4": (0.98, 0.25),
+                        "fp6": (0.99, 0.15)}[mode]
     assert cos > cos_min, cos
     rel = np.linalg.norm(lq - lf) / np.linalg.norm(lf)
     assert rel < rel_max, rel
 
 
-@pytest.mark.parametrize("mode", ["int8", "fp8", "int4"])
+@pytest.mark.parametrize("mode", ["int8", "fp8", "int4", "fp6"])
 def test_quantized_v1_engine_generates(devices, mode):
     from deepspeed_tpu.parallel.mesh import build_mesh
     from deepspeed_tpu.inference.engine import InferenceEngineTPU
@@ -237,7 +290,7 @@ def test_qmatmul_batched_int4_matches_dequant_reference():
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("mode", ["int8", "fp8", "int4"])
+@pytest.mark.parametrize("mode", ["int8", "fp8", "int4", "fp6"])
 def test_quantized_moe_forward_close_to_float(devices, mode):
     """MoE expert weights quantize per-expert and the moe_layer routes
     through qmatmul_batched; logits must stay near the float model."""
@@ -257,7 +310,7 @@ def test_quantized_moe_forward_close_to_float(devices, mode):
     lf = np.asarray(transformer.forward(cfg, params, tokens, moe_fn=moe_fn))
     lq = np.asarray(transformer.forward(cfg, qp, tokens, moe_fn=moe_fn))
     cos = np.sum(lf * lq) / (np.linalg.norm(lf) * np.linalg.norm(lq))
-    assert cos > (0.97 if mode == "int4" else 0.99), cos
+    assert cos > (0.97 if mode in ("int4", "fp6") else 0.99), cos
 
 
 def test_weight_quant_rejects_ep(devices):
@@ -300,4 +353,4 @@ def test_weight_quant_invalid_mode_fails_fast(devices):
     with pytest.raises(ValueError, match="'int4'"):
         InferenceEngineTPU(cfg, {"weight_quant": "int3"})
     with pytest.raises(ValueError, match="'int4'"):
-        RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp6"})
+        RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp4"})
