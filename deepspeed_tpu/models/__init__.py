@@ -23,6 +23,7 @@ from deepspeed_tpu.models.gptj import gptj_config
 from deepspeed_tpu.models.bert import bert_config, distilbert_config
 from deepspeed_tpu.models.gptneo import gptneo_config
 from deepspeed_tpu.models.internlm import internlm_config
+from deepspeed_tpu.models.megatron import load_megatron_checkpoint
 
 __all__ = [
     "DecoderConfig", "init_params", "forward", "partition_specs",
@@ -32,5 +33,5 @@ __all__ = [
     "gpt_bigcode_config", "qwen2_moe_config", "gptj_config",
     "phi_config", "opt_config", "gemma_config", "bloom_config",
     "bert_config", "distilbert_config", "gptneo_config",
-    "internlm_config",
+    "internlm_config", "load_megatron_checkpoint",
 ]

@@ -1,0 +1,178 @@
+"""Megatron-LM GPT checkpoint import (reference:
+module_inject/containers/megatron_gpt.py + features/megatron.py).
+
+The reference's v1 inference kernel-injects Megatron-LM
+``ParallelTransformerLayer`` models; its policy documents the layout
+this loader maps into the pytree:
+
+- fused ``(self_)attention.query_key_value`` with the HEAD-MAJOR
+  per-head [q|k|v] interleave (features/megatron.py:_align_qkv_transposed
+  splits the out dim viewed as [H, 3·dh] into per-head thirds — the same
+  convention as GPT-NeoX, which this repo's loaders already roundtrip
+  against transformers);
+- GPT-2 block otherwise: learned positions, LayerNorm with bias,
+  sequential residual, dense_h_to_4h/dense_4h_to_h MLP, tied head.
+
+Accepts the standard ``mp_rank_00/model_optim_rng.pt`` layout (or a
+direct .pt path), reads model hyperparameters from the checkpoint's
+``args`` when present, and handles both the modern (``encoder`` /
+``self_attention``) and legacy (``transformer`` / ``attention``)
+sub-module names.
+"""
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import DecoderConfig
+from deepspeed_tpu.utils.logging import logger
+
+Params = Any
+
+
+def _flatten(d, prefix="") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, name))
+        else:
+            out[name] = v
+    return out
+
+
+def _resolve_ckpt_file(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        ranks = sorted(d for d in os.listdir(path)
+                       if d.startswith("mp_rank_"))
+        if len(ranks) > 1:
+            # silently reading only rank 0's shard would return a
+            # structurally-valid half-sized garbage model
+            raise NotImplementedError(
+                f"checkpoint at {path!r} is tensor-parallel sharded "
+                f"({len(ranks)} mp_rank_* dirs); merge the TP shards "
+                "first (concatenate qkv/h_to_4h on the out dim, "
+                "dense/4h_to_h on the in dim) — sharded import is not "
+                "supported")
+    for sub in ("mp_rank_00/model_optim_rng.pt",
+                "mp_rank_00/model_rng.pt", "model_optim_rng.pt"):
+        cand = os.path.join(path, sub)
+        if os.path.isfile(cand):
+            return cand
+    raise FileNotFoundError(
+        f"no Megatron checkpoint found under {path!r} (looked for "
+        "mp_rank_00/model_optim_rng.pt and friends)")
+
+
+def load_megatron_checkpoint(path: str,
+                             num_heads: Optional[int] = None,
+                             activation: str = "gelu_exact",
+                             dtype=np.float32
+                             ) -> Tuple[DecoderConfig, Params]:
+    """Megatron-LM GPT checkpoint → (DecoderConfig, params pytree).
+
+    ``num_heads`` overrides the value from the checkpoint's ``args``
+    (required when the checkpoint carries no args). ``activation``:
+    Megatron's default F.gelu is the exact erf form; pass ``"gelu"``
+    for models trained with the tanh/openai variant.
+    """
+    import torch
+    f = _resolve_ckpt_file(path)
+    ckpt = torch.load(f, map_location="cpu", weights_only=False)
+    model = ckpt.get("model", ckpt)
+    lm = model.get("language_model", model)
+    flat = {k: (v.float().numpy() if hasattr(v, "numpy") else
+                np.asarray(v, np.float32))
+            for k, v in _flatten(lm).items()
+            if hasattr(v, "shape")}
+
+    args = ckpt.get("args")
+    emb = flat["embedding.word_embeddings.weight"]
+    pos = flat["embedding.position_embeddings.weight"]
+    core = "encoder" if any(k.startswith("encoder.") for k in flat) \
+        else "transformer"
+    attn = "self_attention" if \
+        f"{core}.layers.0.self_attention.query_key_value.weight" in flat \
+        else "attention"
+    L = 1 + max(int(k.split(".")[2]) for k in flat
+                if k.startswith(f"{core}.layers."))
+    D = emb.shape[1]
+    H = num_heads or (getattr(args, "num_attention_heads", None)
+                      if args is not None else None)
+    if H is None:
+        raise ValueError(
+            "checkpoint has no 'args'; pass num_heads= explicitly")
+    ffn = flat[f"{core}.layers.0.mlp.dense_h_to_4h.weight"].shape[0]
+    # --untie-embeddings-and-output-weights checkpoints carry an
+    # explicit output_layer; dropping it would silently decode through
+    # the (different) word embeddings
+    untied = "output_layer.weight" in flat
+    cfg = DecoderConfig(
+        hidden_size=D, num_layers=L, num_heads=int(H),
+        intermediate_size=int(ffn),
+        vocab_size=emb.shape[0], max_seq_len=pos.shape[0],
+        norm="layernorm", activation=activation, pos_emb="learned",
+        norm_eps=float(getattr(args, "layernorm_epsilon", 1e-5)
+                       if args is not None else 1e-5),
+        use_bias=True, tie_embeddings=not untied)
+
+    dh = cfg.head_dim
+    p = f"{core}.layers.{{}}.{attn}."
+
+    def split_qkv_w(i):
+        w = flat[p.format(i) + "query_key_value.weight"]
+        w = w.astype(dtype).reshape(int(H), 3, dh, D)
+        return tuple(np.ascontiguousarray(
+            w[:, j].reshape(int(H) * dh, D).T) for j in range(3))
+
+    def split_qkv_b(i):
+        b = flat[p.format(i) + "query_key_value.bias"]
+        b = b.astype(dtype).reshape(int(H), 3, dh)
+        return tuple(b[:, j].reshape(-1) for j in range(3))
+
+    def stack(fmt):
+        return np.stack([flat[fmt.format(i)].astype(dtype)
+                         for i in range(L)])
+
+    def stackT(fmt):
+        return np.stack([np.ascontiguousarray(
+            flat[fmt.format(i)].astype(dtype).T) for i in range(L)])
+
+    qw, kw, vw = zip(*(split_qkv_w(i) for i in range(L)))
+    qb, kb, vb = zip(*(split_qkv_b(i) for i in range(L)))
+    lp = f"{core}.layers.{{}}."
+    layers = {
+        "attn": {
+            "wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+            "wo": stackT(p + "dense.weight"),
+            "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+            "bo": stack(p + "dense.bias"),
+        },
+        "ln1": {"scale": stack(lp + "input_layernorm.weight"),
+                "bias": stack(lp + "input_layernorm.bias")},
+        "ln2": {"scale": stack(lp + "post_attention_layernorm.weight"),
+                "bias": stack(lp + "post_attention_layernorm.bias")},
+        "mlp": {
+            "wi": stackT(lp + "mlp.dense_h_to_4h.weight"),
+            "bi": stack(lp + "mlp.dense_h_to_4h.bias"),
+            "wo": stackT(lp + "mlp.dense_4h_to_h.weight"),
+            "bo": stack(lp + "mlp.dense_4h_to_h.bias"),
+        },
+    }
+    params: Params = {
+        "embed": {"tokens": emb.astype(dtype), "pos": pos.astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": flat[f"{core}.final_layernorm.weight"].astype(dtype),
+            "bias": flat[f"{core}.final_layernorm.bias"].astype(dtype)},
+    }
+    if untied:
+        params["lm_head"] = np.ascontiguousarray(
+            flat["output_layer.weight"].astype(dtype).T)
+    logger.info(f"loaded Megatron checkpoint from {path}: "
+                f"{cfg.num_params() / 1e6:.1f}M params, {L} layers, "
+                f"{attn} naming")
+    return cfg, params

@@ -147,8 +147,9 @@ def quantize_weight(w: jax.Array, mode: str = "int8"
     and inference/quantization 4-bit serving.
     ``mode="fp6"``: e3m2 floats (scale = max|w|/28), FOUR values packed
     per THREE bytes → storage [3, K/4, N] uint8 (plane-major
-    split-quarters layout, same one-contiguous-tile property). Reference analogue: the FP6-LLM
-    path in ops/fp_quantizer (csrc/fp_quantizer/fp_quantize.cu).
+    split-quarters layout, same one-contiguous-tile property).
+    Reference analogue: the FP6-LLM path in ops/fp_quantizer
+    (csrc/fp_quantizer/fp_quantize.cu).
     """
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
     if mode == "fp8":
@@ -209,8 +210,8 @@ def _tile(dim: int) -> int:
 
 def _pad_m(x: jax.Array, m: int, axis: int):
     """Pad the M (rows) axis up to a sublane multiple; returns
-    (padded x, padded m, block m). Shared by all four kernel wrappers so
-    a tiling tweak can't silently diverge between them."""
+    (padded x, padded m, block m). Shared by every kernel wrapper so a
+    tiling tweak can't silently diverge between them."""
     mp = max(8, -(-m // 8) * 8)
     bm = mp if mp <= 256 else 256
     if mp % bm:
@@ -270,107 +271,129 @@ def _qmm(x: jax.Array, w: jax.Array, scale: jax.Array, bm: int, bn: int,
     )(x, w, s2)
 
 
-def _qmm4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_ref, *,
-                 nk: int):
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    p = w_ref[...].astype(jnp.int32)
-    lo = _nibble(p).astype(jnp.bfloat16)        # rows [kk .. kk+bkp)
-    hi = _nibble(p >> 4).astype(jnp.bfloat16)   # rows [Kp+kk .. )
-    acc_ref[...] += lax.dot_general(
-        xlo_ref[...], lo, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    acc_ref[...] += lax.dot_general(
-        xhi_ref[...], hi, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(k == nk - 1)
-    def _flush():
-        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
+def _unpack_int4_planes(w_blk):
+    """uint8 [bk, bn] → (lo, hi) bf16 planes (rows kk / Kp+kk)."""
+    p = w_blk.astype(jnp.int32)
+    return (_nibble(p).astype(jnp.bfloat16),
+            _nibble(p >> 4).astype(jnp.bfloat16))
 
 
-def _qmm4(x: jax.Array, w_q: jax.Array, scale: jax.Array, bm: int, bn: int,
-          bkp: int, interpret: bool, out_dtype) -> jax.Array:
-    """int4 path: w_q [Kp, N] uint8 (Kp = K/2); x [M, K]."""
-    m, k = x.shape
-    kp, n = w_q.shape
-    nk = kp // bkp
-    s2 = scale.astype(jnp.float32).reshape(1, n)
+def _unpack_fp6_planes(w_blk):
+    """uint8 [3, bk, bn] → four bf16 quarter-planes (e3m2 decoded)."""
+    return tuple(_fp6_decode_bits(v).astype(jnp.bfloat16)
+                 for v in _fp6_unpack_bits(w_blk))
+
+
+_PACKED = {
+    # planes per byte-group, in-kernel unpack, whole-array unpack
+    "int4": (2, _unpack_int4_planes, unpack_int4),
+    "fp6": (4, _unpack_fp6_planes, unpack_fp6),
+}
+
+
+def _make_packed_kernel(planes: int, unpack, batched: bool):
+    """One kernel body serves int4 and fp6, dense and grouped: the x
+    column tiles matching each packed plane arrive as separate refs."""
+    def kernel(*refs, nk: int):
+        x_refs = refs[:planes]
+        w_ref, s_ref, o_ref, acc_ref = refs[planes:]
+        k = pl.program_id(3 if batched else 2)
+
+        @pl.when(k == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        w_blk = w_ref[0] if batched else w_ref[...]
+        for x_ref, plane in zip(x_refs, unpack(w_blk)):
+            acc_ref[...] += lax.dot_general(
+                x_ref[0] if batched else x_ref[...], plane,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(k == nk - 1)
+        def _flush():
+            if batched:
+                o_ref[0] = (acc_ref[...] *
+                            s_ref[0, 0][None, :]).astype(o_ref.dtype)
+            else:
+                o_ref[...] = (acc_ref[...] *
+                              s_ref[0][None, :]).astype(o_ref.dtype)
+    return kernel
+
+
+def _packed_qmm(x, w_q, scale, *, mode: str, interpret: bool, out_dtype,
+                batched: bool):
+    """Shared wrapper for ALL bit-packed weight matmuls (int4/fp6 ×
+    dense/grouped): one home for shape validation, tiling, M padding,
+    BlockSpecs and the XLA fallback, so a pipelining or tiling tweak
+    cannot silently diverge between formats."""
+    planes, unpack, unpack_all = _PACKED[mode]
+    if batched:
+        g, m, k = x.shape
+    else:
+        m, k = x.shape
+    kp, n = w_q.shape[-2], w_q.shape[-1]
+    if planes * kp != k:
+        raise ValueError(
+            f"qmatmul({mode}): packed rows {kp} != K/{planes} for x "
+            f"K={k}")
+    bk, bn = _tile(kp), _tile(n)
+    out_dtype = out_dtype or x.dtype
+    if not bk or not bn:
+        logger.warning(
+            f"qmatmul{'_batched' if batched else ''}({mode}): "
+            f"K/{planes}={kp}/N={n} not tileable; using XLA dequant path")
+        if batched:
+            w = unpack_all(w_q).astype(jnp.float32) * scale[:, None, :]
+            return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
+                              w).astype(out_dtype)
+        w = unpack_all(w_q).astype(jnp.float32) * scale[None, :]
+        return (x.astype(jnp.float32) @ w).astype(out_dtype)
+    xp, mp, bm = _pad_m(x, m, 1 if batched else 0)
+    nk = kp // bk
+    kern = functools.partial(_make_packed_kernel(planes, unpack, batched),
+                             nk=nk)
     kw = {}
-    if not interpret:
-        kw["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    return pl.pallas_call(
-        functools.partial(_qmm4_kernel, nk=nk),
-        grid=(m // bm, n // bn, nk),
-        in_specs=[
-            # the same x is passed twice: low-half and high-half column
-            # tiles matching the packed row tile
-            pl.BlockSpec((bm, bkp), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bm, bkp), lambda i, j, kk, _nk=nk: (i, kk + _nk)),
-            pl.BlockSpec((bkp, bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+    if batched:
+        x_specs = [
+            pl.BlockSpec((1, bm, bk), lambda gg, i, j, kk, _q=q, _nk=nk:
+                         (gg, i, kk + _q * _nk)) for q in range(planes)]
+        w_spec = pl.BlockSpec((1, bk, bn),
+                              lambda gg, i, j, kk: (gg, kk, j))             if mode == "int4" else             pl.BlockSpec((1, 3, bk, bn),
+                         lambda gg, i, j, kk: (gg, 0, kk, j))
+        s_arr = scale.astype(jnp.float32).reshape(g, 1, n)
+        s_spec = pl.BlockSpec((1, 1, bn), lambda gg, i, j, kk: (gg, 0, j))
+        out_spec = pl.BlockSpec((1, bm, bn),
+                                lambda gg, i, j, kk: (gg, i, j))
+        grid = (g, mp // bm, n // bn, nk)
+        out_shape = jax.ShapeDtypeStruct((g, mp, n), out_dtype)
+        if not interpret:
+            kw["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary"))
+    else:
+        x_specs = [
+            pl.BlockSpec((bm, bk), lambda i, j, kk, _q=q, _nk=nk:
+                         (i, kk + _q * _nk)) for q in range(planes)]
+        w_spec = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))             if mode == "int4" else             pl.BlockSpec((3, bk, bn), lambda i, j, kk: (0, kk, j))
+        s_arr = scale.astype(jnp.float32).reshape(1, n)
+        s_spec = pl.BlockSpec((1, bn), lambda i, j, kk: (0, j))
+        out_spec = pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))
+        grid = (mp // bm, n // bn, nk)
+        out_shape = jax.ShapeDtypeStruct((mp, n), out_dtype)
+        if not interpret:
+            kw["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kern, grid=grid,
+        in_specs=x_specs + [w_spec, s_spec],
+        out_specs=out_spec, out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-        **kw,
-    )(x, x, w_q, s2)
-
-
-def _qmm6_kernel(x0_ref, x1_ref, x2_ref, x3_ref, w_ref, s_ref, o_ref,
-                 acc_ref, *, nk: int):
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    vs = _fp6_unpack_bits(w_ref[...])
-    for x_ref, v in zip((x0_ref, x1_ref, x2_ref, x3_ref), vs):
-        acc_ref[...] += lax.dot_general(
-            x_ref[...], _fp6_decode_bits(v).astype(jnp.bfloat16),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(k == nk - 1)
-    def _flush():
-        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
-
-
-def _qmm6(x: jax.Array, w_q: jax.Array, scale: jax.Array, bm: int, bn: int,
-          bkq: int, interpret: bool, out_dtype) -> jax.Array:
-    """fp6 path: w_q [3, Kq, N] uint8 (Kq = K/4); x [M, K]."""
-    m, k = x.shape
-    _, kq, n = w_q.shape
-    nk = kq // bkq
-    s2 = scale.astype(jnp.float32).reshape(1, n)
-    kw = {}
-    if not interpret:
-        kw["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    x_specs = [
-        pl.BlockSpec((bm, bkq), lambda i, j, kk, _q=q, _nk=nk:
-                     (i, kk + _q * _nk))
-        for q in range(4)]
-    return pl.pallas_call(
-        functools.partial(_qmm6_kernel, nk=nk),
-        grid=(m // bm, n // bn, nk),
-        in_specs=x_specs + [
-            pl.BlockSpec((3, bkq, bn), lambda i, j, kk: (0, kk, j)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-        **kw,
-    )(x, x, x, x, w_q, s2)
+        interpret=interpret, **kw,
+    )(*([xp] * planes), w_q, s_arr)
+    if mp == m:
+        return out
+    return out[:, :m] if batched else out[:m]
 
 
 def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
@@ -386,38 +409,10 @@ def qmatmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     m, k = x.shape
-    if w_q.dtype == jnp.uint8 and w_q.ndim == 3:   # fp6: [3, K/4, N]
-        kq, n = w_q.shape[1], w_q.shape[2]
-        if 4 * kq != k:
-            raise ValueError(
-                f"qmatmul(fp6): packed rows {kq} != K/4 for x K={k}")
-        bkq, bn = _tile(kq), _tile(n)
-        out_dtype = out_dtype or x.dtype
-        if not bkq or not bn:
-            logger.warning(
-                f"qmatmul(fp6): K/4={kq}/N={n} not tileable; using XLA "
-                "dequant path")
-            w = unpack_fp6(w_q) * scale[None, :]
-            return (x.astype(jnp.float32) @ w).astype(out_dtype)
-        xp, mp, bm = _pad_m(x, m, 0)
-        out = _qmm6(xp, w_q, scale, bm, bn, bkq, interpret, out_dtype)
-        return out[:m] if mp != m else out
-    if w_q.dtype == jnp.uint8:   # int4 packed: [K/2, N]
-        kp, n = w_q.shape
-        if 2 * kp != k:
-            raise ValueError(
-                f"qmatmul(int4): packed rows {kp} != K/2 for x K={k}")
-        bkp, bn = _tile(kp), _tile(n)
-        out_dtype = out_dtype or x.dtype
-        if not bkp or not bn:
-            logger.warning(
-                f"qmatmul(int4): K/2={kp}/N={n} not tileable; using XLA "
-                "dequant path")
-            w = unpack_int4(w_q).astype(jnp.float32) * scale[None, :]
-            return (x.astype(jnp.float32) @ w).astype(out_dtype)
-        xp, mp, bm = _pad_m(x, m, 0)
-        out = _qmm4(xp, w_q, scale, bm, bn, bkp, interpret, out_dtype)
-        return out[:m] if mp != m else out
+    if w_q.dtype == jnp.uint8:   # packed: fp6 [3, K/4, N] or int4 [K/2, N]
+        mode = "fp6" if w_q.ndim == 3 else "int4"
+        return _packed_qmm(x, w_q, scale, mode=mode, interpret=interpret,
+                           out_dtype=out_dtype, batched=False)
     n = w_q.shape[1]
     bk, bn = _tile(k), _tile(n)
     out_dtype = out_dtype or x.dtype
@@ -466,10 +461,10 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     g, m, k = x.shape
-    if w_q.dtype == jnp.uint8 and w_q.ndim == 4:   # fp6: [G, 3, K/4, N]
-        return _qmm6_batched(x, w_q, scale, interpret, out_dtype)
-    if w_q.dtype == jnp.uint8:   # int4 packed: [G, K/2, N]
-        return _qmm4_batched(x, w_q, scale, interpret, out_dtype)
+    if w_q.dtype == jnp.uint8:   # packed: fp6 [G,3,K/4,N] or int4 [G,K/2,N]
+        mode = "fp6" if w_q.ndim == 4 else "int4"
+        return _packed_qmm(x, w_q, scale, mode=mode, interpret=interpret,
+                           out_dtype=out_dtype, batched=True)
     n = w_q.shape[2]
     bk, bn = _tile(k), _tile(n)
     out_dtype = out_dtype or x.dtype
@@ -503,141 +498,6 @@ def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         interpret=interpret,
         **kw,
     )(xp, w_q, s3)
-    return out[:, :m] if mp != m else out
-
-
-def _qmm4_batched_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_ref,
-                         *, nk: int):
-    k = pl.program_id(3)
-
-    @pl.when(k == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    p = w_ref[0].astype(jnp.int32)
-    lo = _nibble(p).astype(jnp.bfloat16)
-    hi = _nibble(p >> 4).astype(jnp.bfloat16)
-    acc_ref[...] += lax.dot_general(
-        xlo_ref[0], lo, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    acc_ref[...] += lax.dot_general(
-        xhi_ref[0], hi, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(k == nk - 1)
-    def _flush():
-        o_ref[0] = (acc_ref[...] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
-
-
-def _qmm4_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
-                  interpret: bool, out_dtype) -> jax.Array:
-    """Grouped int4 path: x [G, M, K] @ packed [G, K/2, N]."""
-    g, m, k = x.shape
-    kp, n = w_q.shape[1], w_q.shape[2]
-    if 2 * kp != k:
-        raise ValueError(
-            f"qmatmul_batched(int4): packed rows {kp} != K/2 for x K={k}")
-    bkp, bn = _tile(kp), _tile(n)
-    out_dtype = out_dtype or x.dtype
-    if not bkp or not bn:
-        logger.warning(
-            f"qmatmul_batched(int4): K/2={kp}/N={n} not tileable; using "
-            "XLA dequant path (materializes fp32 expert weights)")
-        w = unpack_int4(w_q).astype(jnp.float32) * scale[:, None, :]
-        return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
-                          w).astype(out_dtype)
-    xp, mp, bm = _pad_m(x, m, 1)
-    nk = kp // bkp
-    s3 = scale.astype(jnp.float32).reshape(g, 1, n)
-    kw = {}
-    if not interpret:
-        kw["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"))
-    out = pl.pallas_call(
-        functools.partial(_qmm4_batched_kernel, nk=nk),
-        grid=(g, mp // bm, n // bn, nk),
-        in_specs=[
-            pl.BlockSpec((1, bm, bkp), lambda gg, i, j, kk: (gg, i, kk)),
-            pl.BlockSpec((1, bm, bkp),
-                         lambda gg, i, j, kk, _nk=nk: (gg, i, kk + _nk)),
-            pl.BlockSpec((1, bkp, bn), lambda gg, i, j, kk: (gg, kk, j)),
-            pl.BlockSpec((1, 1, bn), lambda gg, i, j, kk: (gg, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, bm, bn),
-                               lambda gg, i, j, kk: (gg, i, j)),
-        out_shape=jax.ShapeDtypeStruct((g, mp, n), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-        **kw,
-    )(xp, xp, w_q, s3)
-    return out[:, :m] if mp != m else out
-
-
-def _qmm6_batched_kernel(x0_ref, x1_ref, x2_ref, x3_ref, w_ref, s_ref,
-                         o_ref, acc_ref, *, nk: int):
-    k = pl.program_id(3)
-
-    @pl.when(k == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    vs = _fp6_unpack_bits(w_ref[0])   # [3, bkq, bn] → 4 planes
-    for x_ref, v in zip((x0_ref, x1_ref, x2_ref, x3_ref), vs):
-        acc_ref[...] += lax.dot_general(
-            x_ref[0], _fp6_decode_bits(v).astype(jnp.bfloat16),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(k == nk - 1)
-    def _flush():
-        o_ref[0] = (acc_ref[...] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
-
-
-def _qmm6_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,
-                  interpret: bool, out_dtype) -> jax.Array:
-    """Grouped fp6 path: x [G, M, K] @ packed [G, 3, K/4, N]."""
-    g, m, k = x.shape
-    kq, n = w_q.shape[2], w_q.shape[3]
-    if 4 * kq != k:
-        raise ValueError(
-            f"qmatmul_batched(fp6): packed rows {kq} != K/4 for x K={k}")
-    bkq, bn = _tile(kq), _tile(n)
-    out_dtype = out_dtype or x.dtype
-    if not bkq or not bn:
-        logger.warning(
-            f"qmatmul_batched(fp6): K/4={kq}/N={n} not tileable; using "
-            "XLA dequant path (materializes fp32 expert weights)")
-        w = unpack_fp6(w_q) * scale[:, None, :]
-        return jnp.einsum("gmk,gkn->gmn", x.astype(jnp.float32),
-                          w).astype(out_dtype)
-    xp, mp, bm = _pad_m(x, m, 1)
-    nk = kq // bkq
-    s3 = scale.astype(jnp.float32).reshape(g, 1, n)
-    kw = {}
-    if not interpret:
-        kw["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"))
-    x_specs = [
-        pl.BlockSpec((1, bm, bkq), lambda gg, i, j, kk, _q=q, _nk=nk:
-                     (gg, i, kk + _q * _nk))
-        for q in range(4)]
-    out = pl.pallas_call(
-        functools.partial(_qmm6_batched_kernel, nk=nk),
-        grid=(g, mp // bm, n // bn, nk),
-        in_specs=x_specs + [
-            pl.BlockSpec((1, 3, bkq, bn),
-                         lambda gg, i, j, kk: (gg, 0, kk, j)),
-            pl.BlockSpec((1, 1, bn), lambda gg, i, j, kk: (gg, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, bm, bn),
-                               lambda gg, i, j, kk: (gg, i, j)),
-        out_shape=jax.ShapeDtypeStruct((g, mp, n), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-        **kw,
-    )(xp, xp, xp, xp, w_q, s3)
     return out[:, :m] if mp != m else out
 
 
