@@ -54,6 +54,24 @@ class DeepSpeedTPUInferenceConfig(TPUConfigModel):
         return int(self.tensor_parallel.get("tp_size", 1) or 1)
 
 
+def _is_quantized_tree(params) -> bool:
+    """True when the pytree carries serving-quantization leaves
+    (``<name>_scale`` / ``lm_head_q``) — e.g. a bin/dstpu_quantize
+    output reloaded from disk."""
+    from deepspeed_tpu.ops.quantized_linear import SCALE_SUFFIX
+
+    def walk(d):
+        for k, v in d.items():
+            if isinstance(k, str) and (k.endswith(SCALE_SUFFIX)
+                                       or k == "lm_head_q"):
+                return True
+            if isinstance(v, dict) and walk(v):
+                return True
+        return False
+
+    return isinstance(params, dict) and walk(params)
+
+
 def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
     """Shared serving-engine bring-up (v1 generator + encoder engine):
     mesh resolution, dtype policy, TP/EP weight-quant guards, GSPMD
@@ -93,6 +111,46 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
         init = jax.jit(lambda r: jax.tree.map(cast, init_params(model, r)),
                        out_shardings=param_sh)
         params = init(rng)
+    elif _is_quantized_tree(params):
+        # pre-quantized tree (bin/dstpu_quantize output): extra _scale /
+        # lm_head_q leaves don't match the partition-spec pytree, and
+        # quantized leaves only serve unsharded anyway (same restriction
+        # as weight_quant) — replicate onto the mesh leaf-wise
+        if tp:
+            raise ValueError(
+                "pre-quantized params require tp_size=1 / a mesh with "
+                "model axis 1 (quantized leaves are not TP-sharded)")
+        if model.num_experts and mesh.shape["expert"] > 1:
+            raise ValueError(
+                "pre-quantized MoE params require an expert mesh axis "
+                "of 1 (same restriction as weight_quant: the grouped "
+                "dequant kernel would be replicated, silently losing EP "
+                "and the memory win)")
+        if config.weight_quant:
+            raise ValueError(
+                "params are already quantized (scale leaves present); "
+                "drop weight_quant from the config")
+        rep = NamedSharding(mesh, P())
+
+        def put_q(d):
+            # dtype policy must NOT touch the quantization artifacts:
+            # fp8 weights are a floating dtype (casting them to bf16
+            # would silently undo the memory win) and _scale leaves
+            # must stay f32 (bf16 scales shift every channel by up to
+            # 2^-9 vs the startup-quantization path)
+            out = {}
+            for k, v in d.items():
+                if isinstance(v, dict):
+                    out[k] = put_q(v)
+                    continue
+                keep = (k.endswith("_scale") or k == "lm_head_q"
+                        or v.dtype == jnp.float8_e4m3fn
+                        or not jnp.issubdtype(v.dtype, jnp.floating))
+                out[k] = jax.device_put(v if keep else v.astype(dtype),
+                                        rep)
+            return out
+
+        return mesh, dtype, put_q(params), param_sh
     else:
         params = jax.device_put(jax.tree.map(cast, params), param_sh)
     if config.weight_quant:

@@ -1,0 +1,128 @@
+"""Offline quantization CLI (reference: module_quantize.py offline
+flow). Quantize an HF checkpoint once, reload the npz, and serve —
+logits must equal the engine's own startup quantization path."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_loader("dstpu_quantize", loader=None)
+    mod = importlib.util.module_from_spec(spec)
+    src = open(os.path.join(REPO, "bin", "dstpu_quantize")).read()
+    exec(compile(src, "dstpu_quantize", "exec"), mod.__dict__)
+    return mod
+
+
+def _tiny_llama_dir(tmp_path):
+    cfg = LlamaConfig(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, vocab_size=256,
+                      max_position_embeddings=128,
+                      tie_word_embeddings=True, attention_bias=False)
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).save_pretrained(str(tmp_path / "hf"),
+                                          safe_serialization=True)
+    return str(tmp_path / "hf")
+
+
+def test_quantize_cli_roundtrip(tmp_path, devices):
+    model_dir = _tiny_llama_dir(tmp_path)
+    out = str(tmp_path / "q.npz")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dstpu_quantize"),
+         "--model-dir", model_dir, "--mode", "int4", "--out", out,
+         "--report"],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "rel_err" in r.stdout and "wrote" in r.stdout
+
+    cli = _load_cli()
+    cfg, qp = cli.load_quantized_npz(out)
+    assert qp["layers"]["attn"]["wq"].dtype == np.uint8
+
+    # parity vs the engine's own startup quantization of the same ckpt
+    from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+    from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+    from deepspeed_tpu.models import transformer
+    cfg2, params = load_hf_checkpoint(model_dir)
+    qp2 = quantize_param_tree(jax.tree.map(jnp.asarray, params),
+                              mode="int4")
+    tokens = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    a = np.asarray(transformer.forward(
+        cfg, jax.tree.map(jnp.asarray, qp), tokens))
+    b = np.asarray(transformer.forward(cfg2, qp2, tokens))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_cli_fp8_roundtrip(tmp_path, devices):
+    """fp8 leaves survive npz (np.savez turns float8 into opaque void
+    without the uint8-view + meta-tag encoding) and serve at bf16
+    without being upcast."""
+    import ml_dtypes
+    model_dir = _tiny_llama_dir(tmp_path)
+    cli = _load_cli()
+    from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+    from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+    from deepspeed_tpu.models import transformer
+    cfg, params = load_hf_checkpoint(model_dir)
+    qp = quantize_param_tree(jax.tree.map(jnp.asarray, params),
+                             mode="fp8")
+    out = str(tmp_path / "q_fp8.npz")
+    cli.save_quantized_npz(out, cfg, jax.tree.map(np.asarray, qp))
+    cfg2, loaded = cli.load_quantized_npz(out)
+    assert loaded["layers"]["attn"]["wq"].dtype == ml_dtypes.float8_e4m3fn
+    tokens = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    a = np.asarray(transformer.forward(
+        cfg2, jax.tree.map(jnp.asarray, loaded), tokens))
+    b = np.asarray(transformer.forward(cfg, qp, tokens))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+    # through the engine at bf16: fp8 leaves must NOT be upcast
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference import InferenceEngineTPU
+    build_mesh(data=1, devices=jax.devices()[:1])
+    eng = InferenceEngineTPU(cfg2, {"dtype": "bfloat16",
+                                    "max_out_tokens": 32}, params=loaded)
+    assert eng.params["layers"]["attn"]["wq"].dtype == jnp.float8_e4m3fn
+    assert eng.params["layers"]["attn"]["wq_scale"].dtype == jnp.float32
+
+
+def test_quantized_npz_serves(tmp_path, devices):
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference import InferenceEngineTPU
+    model_dir = _tiny_llama_dir(tmp_path)
+    out = str(tmp_path / "q8.npz")
+    cli = _load_cli()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+    from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+    cfg, params = load_hf_checkpoint(model_dir)
+    qp = quantize_param_tree(jax.tree.map(jnp.asarray, params),
+                             mode="int8")
+    cli.save_quantized_npz(out, cfg, jax.tree.map(np.asarray, qp))
+
+    cfg2, loaded = cli.load_quantized_npz(out)
+    build_mesh(data=1, devices=jax.devices()[:1])
+    # params are ALREADY quantized: engine must not re-quantize
+    eng = InferenceEngineTPU(cfg2, {"dtype": "float32",
+                                    "max_out_tokens": 32}, params=loaded)
+    outs = eng.generate(np.arange(1, 9, dtype=np.int32)[None],
+                        max_new_tokens=4, temperature=0.0)
+    assert outs.shape == (1, 12)
+    assert (np.asarray(outs) < cfg2.vocab_size).all()
