@@ -10,6 +10,7 @@ from deepspeed_tpu.models.gpt import gpt2_config
 from deepspeed_tpu.models.llama import llama3_config
 from deepspeed_tpu.models.mixtral import mixtral_config
 from deepspeed_tpu.models.mistral import mistral_config
+from deepspeed_tpu.models.qwen import qwen_config
 from deepspeed_tpu.models.qwen2 import qwen2_config
 from deepspeed_tpu.models.falcon import falcon_config
 from deepspeed_tpu.models.gptneox import gptneox_config
@@ -29,7 +30,8 @@ __all__ = [
     "DecoderConfig", "init_params", "forward", "partition_specs",
     "cross_entropy_loss", "dot_product_attention",
     "gpt2_config", "llama3_config", "mixtral_config",
-    "mistral_config", "qwen2_config", "falcon_config", "gptneox_config",
+    "mistral_config", "qwen_config", "qwen2_config", "falcon_config",
+    "gptneox_config",
     "gpt_bigcode_config", "qwen2_moe_config", "gptj_config",
     "phi_config", "opt_config", "gemma_config", "bloom_config",
     "bert_config", "distilbert_config", "gptneo_config",

@@ -30,7 +30,7 @@ Params = Any
 # config mapping
 # ---------------------------------------------------------------------------
 
-_FAMILIES = ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe",
+_FAMILIES = ("llama", "mistral", "mixtral", "qwen", "qwen2", "qwen2_moe",
               "gpt_neox", "gemma", "gpt2", "opt", "bloom", "falcon",
               "phi", "phi3", "gpt_bigcode", "gptj", "bert", "distilbert",
               "gpt_neo", "internlm")
@@ -121,6 +121,31 @@ def config_from_hf(hf: Dict[str, Any]) -> DecoderConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             lm_head_bias=True,
             parallel_block=True, parallel_block_norms=1)
+    if mt == "qwen":
+        # Qwen v1 (reference: inference/v2/model_implementations/qwen/
+        # model.py) — llama math, fused biased c_attn, always MHA with
+        # head_dim = kv_channels; HF intermediate_size is 2x the real
+        # per-projection FFN width (model.py:72)
+        if not hf.get("no_bias", True):
+            # no_bias=false puts biases on c_proj/w1/w2 too; we have no
+            # slots for those — loading would silently drop them
+            raise ValueError("qwen v1 checkpoints with no_bias=false are "
+                             "not supported (c_proj/mlp biases)")
+        dh = int(hf.get("kv_channels", 128))
+        return DecoderConfig(
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"] // 2,
+            vocab_size=hf["vocab_size"],
+            max_seq_len=int(hf.get("seq_length", 8192)),
+            norm="rmsnorm", activation="silu_glu", pos_emb="rope",
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-6)),
+            use_bias=True, attn_out_bias=False, tie_embeddings=False,
+            head_dim_override=(
+                dh if dh * hf["num_attention_heads"] != hf["hidden_size"]
+                else None))
     if mt == "internlm":
         # llama math with "bias": true on all four attention projections
         # (reference: module_inject/containers InternLMLayerPolicy); the
@@ -696,6 +721,8 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         return cfg, _load_neox(cfg, get, dtype)
     if mt == "gpt_neo":
         return cfg, _load_gptneo(cfg, get, names, dtype)
+    if mt == "qwen":
+        return cfg, _load_qwen(cfg, get, names, dtype)
     if mt == "gpt2":
         return cfg, _load_gpt2(cfg, get, names, dtype)
     if mt == "gpt_bigcode":
@@ -1042,6 +1069,39 @@ def _stack_helpers(get, L, dtype):
         return np.stack([np.ascontiguousarray(
             get(fmt.format(i)).astype(dtype).T) for i in range(L)])
     return stack, stackT
+
+
+def _load_qwen(cfg: DecoderConfig, get, names, dtype) -> Params:
+    """Qwen v1 layout (reference: inference/v2/model_implementations/
+    qwen/container.py:54–61): nn.Linear fused ``attn.c_attn`` — contiguous
+    q|k|v thirds on the out dim, WITH bias — over RMSNorm ``ln_1``/``ln_2``
+    (weight only); ``mlp.w1`` is the UP projection and ``mlp.w2`` the GATE
+    (the reference maps w1→up_params, w2→gate_params); ``c_proj`` tensors
+    are bias-less; untied ``lm_head``."""
+    L = cfg.num_layers
+    p = "transformer.h.{}."
+    stack, stackT = _stack_helpers(get, L, dtype)
+
+    qw, kw_, vw = (np.ascontiguousarray(a) for a in np.split(
+        stackT(p + "attn.c_attn.weight"), 3, axis=2))
+    qb, kb, vb = (np.ascontiguousarray(a) for a in np.split(
+        stack(p + "attn.c_attn.bias"), 3, axis=1))
+    layers = {
+        "attn": {"wq": qw, "wk": kw_, "wv": vw,
+                 "wo": stackT(p + "attn.c_proj.weight"),
+                 "bq": qb, "bk": kb, "bv": vb},
+        "ln1": {"scale": stack(p + "ln_1.weight")},
+        "ln2": {"scale": stack(p + "ln_2.weight")},
+        "mlp": {"wi": stackT(p + "mlp.w1.weight"),    # w1 = up
+                "wg": stackT(p + "mlp.w2.weight"),    # w2 = gate
+                "wo": stackT(p + "mlp.c_proj.weight")},
+    }
+    return _attach_untied_head({
+        "embed": {"tokens": get("transformer.wte.weight").astype(dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dtype)},
+    }, cfg, get, names, dtype)
 
 
 def _load_gpt2(cfg: DecoderConfig, get, names, dtype) -> Params:
@@ -1494,7 +1554,7 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
                 # llama attention_bias layout (InternLM): o_proj bias
                 # has a real slot
                 out[p.format(i) + "self_attn.o_proj.bias"] = a["bo"][i]
-            elif np.abs(a["bo"][i]).max() > 1e-6:
+            elif "bo" in a and np.abs(a["bo"][i]).max() > 1e-6:
                 logger.warning(
                     "export_hf_checkpoint: layer %d o_proj bias is "
                     "nonzero but the qwen2 HF layout has no slot for it "

@@ -1,0 +1,146 @@
+"""Qwen v1 family tests (reference: inference/v2/model_implementations/
+qwen/ — the one v2-zoo family round 1 left out as "remote-code-only").
+
+transformers has no in-library Qwen-v1 class, but Qwen-v1's math IS the
+qwen2 math (RMSNorm, rotate-half RoPE, SwiGLU, biased q/k/v, bias-less
+o_proj, untied head) in a GPT-2-style tensor layout — so the parity
+oracle is a tiny ``Qwen2ForCausalLM`` whose state dict we re-serialize
+into the v1 naming: fused ``attn.c_attn`` (q|k|v rows), ``mlp.w1`` = UP
+and ``mlp.w2`` = GATE (the swap the reference container maps at
+container.py:57–58), 2x ``intermediate_size``, ``transformer.h`` prefix.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import torch
+from transformers import Qwen2Config, Qwen2ForCausalLM
+
+from deepspeed_tpu.models.qwen import qwen_config
+from deepspeed_tpu.models.hf_loader import load_hf_checkpoint
+from deepspeed_tpu.models import transformer
+
+
+def _tiny_qwen_dir(tmp_path):
+    """Build a Qwen2 oracle model and save it in Qwen-v1 layout."""
+    cfg = Qwen2Config(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, vocab_size=512,
+                      max_position_embeddings=256, rms_norm_eps=1e-6,
+                      rope_theta=10000.0, tie_word_embeddings=False,
+                      use_sliding_window=False)
+    torch.manual_seed(0)
+    model = Qwen2ForCausalLM(cfg).eval()
+    with torch.no_grad():   # HF inits the qkv biases to 0 — make them real
+        for layer in model.model.layers:
+            for lin in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                        layer.self_attn.v_proj):
+                lin.bias.normal_(0, 0.02)
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    out = {
+        "transformer.wte.weight": sd["model.embed_tokens.weight"],
+        "transformer.ln_f.weight": sd["model.norm.weight"],
+        "lm_head.weight": sd["lm_head.weight"],
+    }
+    for i in range(cfg.num_hidden_layers):
+        hf = f"model.layers.{i}."
+        v1 = f"transformer.h.{i}."
+        out[v1 + "attn.c_attn.weight"] = np.concatenate(
+            [sd[hf + f"self_attn.{x}_proj.weight"] for x in "qkv"], axis=0)
+        out[v1 + "attn.c_attn.bias"] = np.concatenate(
+            [sd[hf + f"self_attn.{x}_proj.bias"] for x in "qkv"], axis=0)
+        out[v1 + "attn.c_proj.weight"] = sd[hf + "self_attn.o_proj.weight"]
+        out[v1 + "mlp.w1.weight"] = sd[hf + "mlp.up_proj.weight"]
+        out[v1 + "mlp.w2.weight"] = sd[hf + "mlp.gate_proj.weight"]
+        out[v1 + "mlp.c_proj.weight"] = sd[hf + "mlp.down_proj.weight"]
+        out[v1 + "ln_1.weight"] = sd[hf + "input_layernorm.weight"]
+        out[v1 + "ln_2.weight"] = sd[hf + "post_attention_layernorm.weight"]
+
+    d = tmp_path / "hf_qwen"
+    d.mkdir()
+    from safetensors.numpy import save_file
+    save_file(out, str(d / "model.safetensors"))
+    with open(d / "config.json", "w") as fh:
+        json.dump({
+            "model_type": "qwen",
+            "architectures": ["QWenLMHeadModel"],
+            "hidden_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "kv_channels": 16,
+            "intermediate_size": 256,    # 2x the real FFN width
+            "vocab_size": 512, "seq_length": 256,
+            "layer_norm_epsilon": 1e-6, "rotary_emb_base": 10000.0,
+            "no_bias": True, "tie_word_embeddings": False,
+        }, fh)
+    return model, str(d)
+
+
+def test_qwen_logits_parity(tmp_path):
+    hf_model, model_dir = _tiny_qwen_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    assert cfg.intermediate_size == 128    # halved from the HF config
+    assert cfg.qkv_bias and not cfg.out_bias
+    assert np.abs(params["layers"]["attn"]["bq"]).max() > 1e-4
+    # w1/w2 swap: wi must be up_proj, wg gate_proj — a naive alphabetical
+    # mapping silently swaps the SwiGLU gate and linear halves
+    up = hf_model.model.layers[0].mlp.up_proj.weight.detach().numpy()
+    np.testing.assert_allclose(params["layers"]["mlp"]["wi"][0], up.T,
+                               rtol=1e-6, atol=1e-6)
+
+    tokens = np.random.default_rng(0).integers(
+        1, cfg.vocab_size, size=(2, 16), dtype=np.int32)
+    ours = np.asarray(transformer.forward(
+        cfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf_model(
+            torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen_export_roundtrip(tmp_path):
+    """Qwen-v1 checkpoints export through the qwen2 layout (same math,
+    separate q/k/v, transformers-loadable without remote code) and
+    reload to identical logits."""
+    from deepspeed_tpu.models.hf_loader import export_hf_checkpoint
+    hf_model, model_dir = _tiny_qwen_dir(tmp_path)
+    cfg, params = load_hf_checkpoint(model_dir)
+    out_dir = str(tmp_path / "export")
+    export_hf_checkpoint(cfg, jax.tree.map(jnp.asarray, params), out_dir)
+    with open(tmp_path / "export" / "config.json") as fh:
+        exported = json.load(fh)
+    assert exported["model_type"] == "qwen2"
+    assert exported["intermediate_size"] == 128
+    reloaded = Qwen2ForCausalLM.from_pretrained(out_dir).eval()
+    tokens = torch.arange(1, 13, dtype=torch.long)[None]
+    with torch.no_grad():
+        np.testing.assert_allclose(reloaded(tokens).logits.numpy(),
+                                   hf_model(tokens).logits.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_qwen_preset_trains():
+    cfg = qwen_config("tiny")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    attn = params["layers"]["attn"]
+    assert "bq" in attn and "bo" not in attn
+    tokens = jnp.asarray(np.random.default_rng(1).integers(
+        0, cfg.vocab_size, size=(2, 16), dtype=np.int32))
+
+    def loss(p):
+        logits = transformer.forward(cfg, p, tokens)
+        return transformer.cross_entropy_loss(logits, tokens)
+
+    l0, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l0))
+    assert np.abs(np.asarray(grads["layers"]["attn"]["bq"])).max() > 0
+
+
+def test_qwen_presets_shapes():
+    c7 = qwen_config("7b")
+    assert c7.num_params() > 7e9 and c7.num_params() < 8.5e9
+    assert c7.kv_heads == c7.num_heads   # v1 is always MHA
+    c18 = qwen_config("1.8b")
+    assert 1.5e9 < c18.num_params() < 2.2e9
