@@ -250,6 +250,11 @@ class MoEConfig(TPUConfigModel):
     drop_tokens: bool = True
     use_rts: bool = True
     aux_loss_coef: float = 0.01
+    # "capacity": GShard einsum dispatch with static capacity (the
+    # reference's only mode; required for ep_size > 1). "dropless":
+    # sort + lax.ragged_dot grouped matmul, no token ever dropped
+    # (MegaBlocks-style; TPU-native extra, EP=1 only).
+    impl: Literal["capacity", "dropless"] = "capacity"
 
 
 # ---------------------------------------------------------------------------
@@ -295,10 +300,24 @@ class CSVConfig(TPUConfigModel):
     job_name: str = "DeepSpeedTPUJobName"
 
 
+class CometConfig(TPUConfigModel):
+    """Reference: monitor/config.py CometConfig (comet_ml writer)."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
 class MonitorConfig(TPUConfigModel):
     """Reference: monitor/config.py → MonitorMaster fan-out."""
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
+    comet: CometConfig = Field(default_factory=CometConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
 
 
@@ -437,7 +456,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
         config = dict(config)
         # accept the reference's nested "monitor" keys at top level
         monitor_keys = {}
-        for key in ("tensorboard", "wandb", "csv_monitor"):
+        for key in ("tensorboard", "wandb", "comet", "csv_monitor"):
             if key in config:
                 monitor_keys[key] = config.pop(key)
         if monitor_keys:

@@ -1,7 +1,7 @@
 """Experiment monitoring fan-out.
 
 Reference: deepspeed/monitor/monitor.py:30 (MonitorMaster → TensorBoard /
-W&B / CSV writers; events written from engine.py:2822). Same fan-out
+W&B / Comet / CSV writers; events written from engine.py:2822). Same fan-out
 design; writers degrade to no-ops when their backend isn't installed.
 Events are ``(name, value, step)`` triples.
 """
@@ -67,6 +67,49 @@ class WandbMonitor(_Writer):
             self.wandb.log({name: value}, step=step)
 
 
+class CometMonitor(_Writer):
+    """Reference monitor/comet.py — comet_ml experiment logging.
+
+    Degrades to a no-op when comet_ml is not installed (it is not baked
+    into the TPU image), matching the other writers' behavior."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            import comet_ml
+            self.experiment = comet_ml.start(
+                api_key=cfg.api_key or None,
+                workspace=cfg.workspace or None,
+                project_name=cfg.project or None,
+                mode=cfg.mode or None,
+                online=cfg.online,
+                experiment_key=cfg.experiment_key or None,
+            )
+            if cfg.experiment_name:
+                self.experiment.set_name(cfg.experiment_name)
+            self.interval = max(1, int(cfg.samples_log_interval))
+            self._last_logged: dict = {}
+            self.enabled = True
+        except Exception as exc:
+            logger.warning(f"comet monitor disabled: {exc}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            # per-metric throttle (reference comet.py EventsLogScheduler):
+            # a metric's FIRST occurrence always logs; afterwards only
+            # when >= samples_log_interval steps passed since its last
+            # send — comet rate-limits server-side, unlike TB/CSV
+            last = self._last_logged.get(name)
+            if last is not None and step - last < self.interval:
+                continue
+            self._last_logged[name] = step
+            self.experiment.log_metric(name, value, step=step)
+
+
 class CSVMonitor(_Writer):
     """Reference monitor/csv_monitor.py — one csv per metric name."""
 
@@ -102,6 +145,7 @@ class MonitorMaster(_Writer):
         if self._is_rank0:
             for w in (TensorBoardMonitor(monitor_config.tensorboard),
                       WandbMonitor(monitor_config.wandb),
+                      CometMonitor(monitor_config.comet),
                       CSVMonitor(monitor_config.csv_monitor)):
                 if w.enabled:
                     self.writers.append(w)

@@ -99,6 +99,72 @@ def topk_gating(logits: jax.Array, k: int, capacity: int,
     return dispatch, combine, aux
 
 
+def dropless_moe_layer(cfg, p, x: jax.Array,
+                       top_k: int = 2,
+                       aux_loss_coef: float = 0.01,
+                       norm_topk: bool = True,
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Dropless MoE via sort + ``lax.ragged_dot`` (MegaBlocks-style).
+
+    TPU-native extra beyond the reference (which only has capacity-based
+    dispatch, ``sharded_moe.py:_capacity``): no token is ever dropped and
+    no capacity padding is computed. Tokens are stably sorted by assigned
+    expert, the expert FFN runs as a grouped (ragged) matmul over the
+    sorted buffer — ``lax.ragged_dot`` tiles each contiguous group onto
+    the MXU — and outputs scatter-add back in token order weighted by
+    the gate values. All shapes are static ([S*k]); only ``group_sizes``
+    is data-dependent, which ragged_dot consumes as a runtime operand, so
+    the whole layer stays jit-compatible.
+
+    Scope: single expert shard (EP=1). Under EP>1 a dropless all-to-all
+    would need dynamic per-shard counts (not jit-static); the capacity
+    path (``moe_layer``) is the EP>1 answer, exactly as MegaBlocks is
+    single-GPU-group scoped. ``select_moe`` enforces this.
+    """
+    b, t, d = x.shape
+    e = p["router"].shape[-1]
+    s = b * t
+    xf = x.reshape(s, d)
+    logits = jnp.einsum("sd,de->se", xf.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)                   # [S,E]
+    topv, topi = lax.top_k(gates, top_k)                      # [S,k]
+    if norm_topk:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # aux loss — identical formulation to the capacity path
+    mask1 = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
+    aux = jnp.sum(gates.mean(axis=0) * mask1.mean(axis=0)) * e
+
+    # stable sort of the S*k (token, slot) assignments by expert id
+    flat_e = topi.reshape(-1)                                 # [S*k]
+    order = jnp.argsort(flat_e, stable=True)                  # [S*k]
+    tok = order // top_k                                      # source token
+    xs = xf[tok]                                              # [S*k, d]
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+
+    gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
+    up_b = lax.ragged_dot(xs, p["wi"].astype(xs.dtype), group_sizes)
+    hidden = jax.nn.silu(gate_b) * up_b
+    out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype), group_sizes)
+
+    w = topv.reshape(-1)[order].astype(x.dtype)               # [S*k]
+    out = jnp.zeros((s, d), x.dtype).at[tok].add(out_s * w[:, None])
+
+    if "shared" in p:   # dense shared expert, same as the capacity path
+        sh = p["shared"]
+        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
+        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
+        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
+                           sh["wo"])
+        if "gate" in sh:
+            s_out = s_out * jax.nn.sigmoid(
+                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
+                           sh["gate"].astype(jnp.float32))).astype(x.dtype)
+        out = out + s_out
+    return out.reshape(b, t, d), aux * aux_loss_coef
+
+
 def moe_layer(cfg, p, x: jax.Array,
               top_k: int = 2,
               capacity_factor: float = 1.0,

@@ -166,6 +166,18 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
 def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):
     if not dec_cfg.num_experts:
         return None
+    if ds_cfg.moe.impl == "dropless":
+        if ds_cfg.moe.ep_size > 1:
+            raise ValueError(
+                "moe.impl='dropless' requires ep_size=1: dropless "
+                "dispatch has data-dependent per-expert counts, which "
+                "cannot cross an EP all-to-all with static shapes. Use "
+                "the capacity impl for expert parallelism.")
+        from deepspeed_tpu.parallel.moe import dropless_moe_layer
+        return partial(dropless_moe_layer,
+                       top_k=dec_cfg.num_experts_per_tok,
+                       aux_loss_coef=ds_cfg.moe.aux_loss_coef,
+                       norm_topk=dec_cfg.norm_topk_prob)
     from deepspeed_tpu.parallel.moe import moe_layer
     return partial(moe_layer,
                    top_k=dec_cfg.num_experts_per_tok,
@@ -203,7 +215,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     # priority, keyed from the engine's per-step rng — only meaningful
     # when capacity can drop tokens
     use_rts = (moe_fn is not None and ds_cfg.moe.use_rts
-               and ds_cfg.moe.drop_tokens)
+               and ds_cfg.moe.drop_tokens
+               and ds_cfg.moe.impl == "capacity")
 
     def _moe_for_step(rng):
         """moe_fn for one step: RTS-wrapped when enabled, raw otherwise

@@ -1,0 +1,102 @@
+"""Monitor fan-out tests (reference: tests/unit/monitor/test_monitor.py).
+
+The reference asserts each writer's enabled state and that MonitorMaster
+routes write_events to every enabled writer; the CSV writer is the one
+backend with no external dependency, so its on-disk output is checked
+for real.
+"""
+
+import csv
+import os
+
+import numpy as np
+import jax
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.monitor.monitor import (CometMonitor, CSVMonitor,
+                                           MonitorMaster,
+                                           TensorBoardMonitor)
+
+
+def _monitor_cfg(**over):
+    # reference style: monitor writers are top-level config keys
+    cfg = DeepSpeedTPUConfig.from_any(
+        {"train_micro_batch_size_per_gpu": 1, **over})
+    return cfg.monitor_config
+
+
+def test_disabled_by_default():
+    mc = _monitor_cfg()
+    master = MonitorMaster(mc)
+    assert not master.enabled
+    assert master.writers == []
+
+
+def test_csv_monitor_writes_rows(tmp_path):
+    mc = _monitor_cfg(csv_monitor={"enabled": True,
+                                   "output_path": str(tmp_path),
+                                   "job_name": "job"})
+    master = MonitorMaster(mc)
+    assert master.enabled and len(master.writers) == 1
+    master.write_events([("Train/loss", 1.5, 1), ("Train/lr", 0.1, 1)])
+    master.write_events([("Train/loss", 1.25, 2)])
+    fname = os.path.join(str(tmp_path), "job", "Train_loss.csv")
+    with open(fname, newline="") as fh:
+        rows = list(csv.reader(fh))
+    assert rows[0] == ["step", "Train/loss"]
+    assert [r[0] for r in rows[1:]] == ["1", "2"]
+    assert float(rows[1][1]) == 1.5 and float(rows[2][1]) == 1.25
+    assert os.path.exists(os.path.join(str(tmp_path), "job",
+                                       "Train_lr.csv"))
+
+
+def test_unavailable_backends_degrade_to_noop(monkeypatch):
+    """An enabled writer whose backend can't import must never raise,
+    only disable (simulated: comet_ml import forced to fail)."""
+    import builtins
+    real_import = builtins.__import__
+
+    def no_comet(name, *a, **k):
+        if name == "comet_ml":
+            raise ImportError("comet_ml not installed")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_comet)
+    mc = _monitor_cfg(comet={"enabled": True})
+    w = CometMonitor(mc.comet)
+    assert not w.enabled            # comet_ml absent → warned + disabled
+    w.write_events([("x", 1.0, 0)])  # no-op, must not raise
+
+    tb = TensorBoardMonitor(mc.tensorboard)   # enabled=False config
+    assert not tb.enabled
+
+
+def test_engine_writes_monitor_events(devices, tmp_path):
+    """End-to-end: engine train steps emit Train/* rows via the CSV
+    writer (reference engine.py:2822 _write_monitor)."""
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    build_mesh(data=8)
+    model = llama3_config("tiny", max_seq_len=32)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "engine"},
+    }
+    eng, *_ = initialize(model=model, config=cfg,
+                         rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, model.vocab_size, size=(8, 32),
+                                       dtype=np.int32)}
+    eng.train_batch(iter([batch]))
+    eng.train_batch(iter([batch]))
+    loss_csv = os.path.join(str(tmp_path), "engine", "Train_loss.csv")
+    assert os.path.exists(loss_csv)
+    with open(loss_csv, newline="") as fh:
+        rows = list(csv.reader(fh))
+    assert len(rows) >= 3            # header + 2 steps
+    assert np.isfinite(float(rows[1][1]))
