@@ -211,26 +211,10 @@ class InferenceEngineTPU:
         # inference/engine.py:260 _create_ep_parallel_group)
         self._moe_fn = None
         if model.num_experts:
-            ep = self.mesh.shape["expert"] > 1
-            # quantized expert weights (startup weight_quant OR a
-            # pre-quantized dstpu_quantize tree) need the capacity
-            # path's scale-aware qmatmul; dropless reads raw leaves
-            quantized = bool(config.weight_quant) or \
-                _is_quantized_tree(self.params)
-            if not ep and not quantized:
-                # dropless grouped matmul: S·k expert-token FLOPs instead
-                # of the capacity path's E·S (4x less for Mixtral top-2)
-                from deepspeed_tpu.parallel.moe import dropless_moe_layer
-                self._moe_fn = partial(
-                    dropless_moe_layer, top_k=model.num_experts_per_tok,
-                    aux_loss_coef=0.0, norm_topk=model.norm_topk_prob)
-            else:
-                from deepspeed_tpu.parallel.moe import moe_layer
-                self._moe_fn = partial(
-                    moe_layer, top_k=model.num_experts_per_tok,
-                    drop_tokens=False, aux_loss_coef=0.0,
-                    ep_axis="expert" if ep else None,
-                    norm_topk=model.norm_topk_prob)
+            from deepspeed_tpu.parallel.moe import serving_moe_fn
+            self._moe_fn = serving_moe_fn(
+                model, config.weight_quant, self.params,
+                ep=self.mesh.shape["expert"] > 1)
         self._step = jax.jit(
             partial(forward_with_cache, model, moe_fn=self._moe_fn),
             donate_argnums=(2,))

@@ -261,25 +261,14 @@ class RaggedInferenceEngineTPU:
                                    model.head_dim, self.dtype)
         moe_fn = None
         if model.num_experts:
-            from functools import partial as _p
-            from deepspeed_tpu.inference.engine import _is_quantized_tree
-            # quantized expert weights (startup weight_quant OR a
-            # pre-quantized dstpu_quantize tree) need the capacity
-            # path's scale-aware qmatmul; dropless reads raw leaves
-            if not config.weight_quant and \
-                    not _is_quantized_tree(self.params):
-                # dropless grouped matmul: S·k expert-token FLOPs
-                # instead of the capacity path's E·S
-                from deepspeed_tpu.parallel.moe import dropless_moe_layer
-                moe_fn = _p(dropless_moe_layer,
-                            top_k=model.num_experts_per_tok,
-                            aux_loss_coef=0.0,
-                            norm_topk=model.norm_topk_prob)
-            else:
-                from deepspeed_tpu.parallel.moe import moe_layer
-                moe_fn = _p(moe_layer, top_k=model.num_experts_per_tok,
-                            drop_tokens=False, aux_loss_coef=0.0,
-                            ep_axis=None, norm_topk=model.norm_topk_prob)
+            from deepspeed_tpu.parallel.moe import serving_moe_fn
+            from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+            # same EP guard as the v1 engine: an ambient expert axis > 1
+            # means capacity dispatch (the ragged engine itself is
+            # single-shard, but the ambient mesh drives GSPMD layouts)
+            ep = has_mesh() and get_mesh().shape.get("expert", 1) > 1
+            moe_fn = serving_moe_fn(model, config.weight_quant,
+                                    self.params, ep=ep)
         self._moe_fn = moe_fn
         #: jit cache keyed on (n_bucket, c_bucket, mode, fresh) — the
         #: fresh=True/False split legitimately doubles prefill-shape

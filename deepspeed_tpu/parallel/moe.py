@@ -21,6 +21,7 @@ top-1 behavior; off → deterministic sequence-order priority.
 """
 
 import math
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -99,6 +100,45 @@ def topk_gating(logits: jax.Array, k: int, capacity: int,
     return dispatch, combine, aux
 
 
+def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
+                  top_k: int) -> jax.Array:
+    """Token-local dropless dispatch: sort + grouped matmul + combine.
+
+    xf [S,d], topv/topi [S,k] → out [S,d]. Every op is per-token local
+    (no collectives), so this body runs unchanged either globally or as
+    the per-shard body of a shard_map over the batch axes.
+    """
+    s, d = xf.shape
+    e = p["wg"].shape[0]
+    # stable sort of the S*k (token, slot) assignments by expert id
+    flat_e = topi.reshape(-1)                                 # [S*k]
+    order = jnp.argsort(flat_e, stable=True)                  # [S*k]
+    tok = order // top_k                                      # source token
+    xs = xf[tok]                                              # [S*k, d]
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+
+    gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
+    up_b = lax.ragged_dot(xs, p["wi"].astype(xs.dtype), group_sizes)
+    hidden = jax.nn.silu(gate_b) * up_b
+    out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype), group_sizes)
+
+    w = topv.reshape(-1)[order].astype(xf.dtype)              # [S*k]
+    out = jnp.zeros((s, d), xf.dtype).at[tok].add(out_s * w[:, None])
+
+    if "shared" in p:   # dense shared expert, same as the capacity path
+        sh = p["shared"]
+        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
+        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
+        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
+                           sh["wo"])
+        if "gate" in sh:
+            s_out = s_out * jax.nn.sigmoid(
+                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
+                           sh["gate"].astype(jnp.float32))).astype(xf.dtype)
+        out = out + s_out
+    return out
+
+
 def dropless_moe_layer(cfg, p, x: jax.Array,
                        top_k: int = 2,
                        aux_loss_coef: float = 0.01,
@@ -116,6 +156,14 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
     is data-dependent, which ragged_dot consumes as a runtime operand, so
     the whole layer stays jit-compatible.
 
+    Routing math (softmax/top-k/aux) is elementwise and stays wherever
+    GSPMD put the tokens; the sort + grouped matmul runs PER DATA SHARD
+    inside a shard_map when batch axes are active — a token's output
+    never depends on other tokens' grouping, so per-shard grouping is
+    exact, and the global argsort's token allgather disappears (it is
+    pure overhead, and an unordered collective next to the grad
+    allreduce can deadlock XLA's CPU thunk runtime).
+
     Scope: single expert shard (EP=1). Under EP>1 a dropless all-to-all
     would need dynamic per-shard counts (not jit-static); the capacity
     path (``moe_layer``) is the EP>1 answer, exactly as MegaBlocks is
@@ -132,37 +180,58 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
     if norm_topk:
         topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
 
-    # aux loss — identical formulation to the capacity path
+    # aux loss — identical formulation to the capacity path (global
+    # means over all tokens, GSPMD-reduced)
     mask1 = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
     aux = jnp.sum(gates.mean(axis=0) * mask1.mean(axis=0)) * e
 
-    # stable sort of the S*k (token, slot) assignments by expert id
-    flat_e = topi.reshape(-1)                                 # [S*k]
-    order = jnp.argsort(flat_e, stable=True)                  # [S*k]
-    tok = order // top_k                                      # source token
-    xs = xf[tok]                                              # [S*k, d]
-    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    batch_axes: Tuple[str, ...] = ()
+    from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+    mesh = get_mesh() if has_mesh() else None
+    if mesh is not None:
+        batch_axes = tuple(
+            a for a in ("data", "data_inner", "expert")
+            if a in mesh.shape and mesh.shape[a] > 1)
+        bdiv = 1
+        for a in batch_axes:
+            bdiv *= mesh.shape[a]
+        if batch_axes and s % bdiv:
+            batch_axes = ()
 
-    gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
-    up_b = lax.ragged_dot(xs, p["wi"].astype(xs.dtype), group_sizes)
-    hidden = jax.nn.silu(gate_b) * up_b
-    out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype), group_sizes)
-
-    w = topv.reshape(-1)[order].astype(x.dtype)               # [S*k]
-    out = jnp.zeros((s, d), x.dtype).at[tok].add(out_s * w[:, None])
-
-    if "shared" in p:   # dense shared expert, same as the capacity path
-        sh = p["shared"]
-        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
-        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
-        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
-                           sh["wo"])
-        if "gate" in sh:
-            s_out = s_out * jax.nn.sigmoid(
-                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
-                           sh["gate"].astype(jnp.float32))).astype(x.dtype)
-        out = out + s_out
+    if batch_axes:
+        spec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0],
+                 None)
+        fn = jax.shard_map(
+            partial(_dropless_ffn, top_k=top_k),
+            mesh=mesh, in_specs=(P(), spec, spec, spec),
+            out_specs=spec, axis_names=set(batch_axes), check_vma=False)
+        out = fn(p, xf, topv, topi)
+    else:
+        out = _dropless_ffn(p, xf, topv, topi, top_k)
     return out.reshape(b, t, d), aux * aux_loss_coef
+
+
+def serving_moe_fn(model, weight_quant, params, ep: bool):
+    """The ONE selection point for both inference engines' ``moe_fn``.
+
+    Serving routes every token deterministically (full capacity, no
+    dropping — reference MoE inference EP, inference/engine.py:260).
+    Dropless is the fast path (S·k instead of E·S expert-token FLOPs)
+    but reads raw weight leaves, so quantized expert weights (startup
+    ``weight_quant`` OR a pre-quantized dstpu_quantize tree) and EP>1
+    (expert-sharded capacity buffers) fall back to the capacity path's
+    scale-aware qmatmul dispatch.
+    """
+    from deepspeed_tpu.inference.engine import _is_quantized_tree
+    quantized = bool(weight_quant) or _is_quantized_tree(params)
+    if not ep and not quantized:
+        return partial(dropless_moe_layer,
+                       top_k=model.num_experts_per_tok,
+                       aux_loss_coef=0.0, norm_topk=model.norm_topk_prob)
+    return partial(moe_layer, top_k=model.num_experts_per_tok,
+                   drop_tokens=False, aux_loss_coef=0.0,
+                   ep_axis="expert" if ep else None,
+                   norm_topk=model.norm_topk_prob)
 
 
 def moe_layer(cfg, p, x: jax.Array,

@@ -173,6 +173,14 @@ def select_moe(dec_cfg: DecoderConfig, ds_cfg: DeepSpeedTPUConfig):
                 "dispatch has data-dependent per-expert counts, which "
                 "cannot cross an EP all-to-all with static shapes. Use "
                 "the capacity impl for expert parallelism.")
+        if ds_cfg.pipeline.stages > 1:
+            raise ValueError(
+                "moe.impl='dropless' does not compose with pipeline "
+                "parallelism: the pipeline already runs layers inside a "
+                "shard_map over 'pipe', and the dropless per-shard "
+                "dispatch is itself a shard_map (nested manual meshes "
+                "conflict, same restriction as PP+SP). Use the capacity "
+                "impl with pipeline stages.")
         from deepspeed_tpu.parallel.moe import dropless_moe_layer
         return partial(dropless_moe_layer,
                        top_k=dec_cfg.num_experts_per_tok,

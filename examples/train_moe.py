@@ -1,0 +1,70 @@
+"""Train a Mixtral-family MoE with expert parallelism or dropless routing.
+
+Usage (single host; the mesh spans all visible devices):
+    python examples/train_moe.py --ep 4 --steps 20          # capacity + EP
+    python examples/train_moe.py --impl dropless --steps 20 # dropless, EP=1
+On CPU for a dry run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_moe.py --ep 4 --steps 3
+
+Two routing modes (docs/parallelism.md "EP"):
+- capacity (reference GShard semantics, deepspeed/moe/sharded_moe.py):
+  static per-expert capacity, over-capacity tokens dropped, shards over
+  the 'expert' mesh axis.
+- dropless (TPU-native extra): sort + lax.ragged_dot grouped matmul —
+  no token drops, no capacity padding; requires ep_size=1.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--impl", default="capacity",
+                    choices=["capacity", "dropless"])
+    ap.add_argument("--capacity-factor", type=float, default=2.0)
+    args = ap.parse_args()
+
+    from _common import setup_jax
+    jax = setup_jax()
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mixtral import mixtral_config
+
+    n = len(jax.devices())
+    ds.build_mesh(data=n // args.ep, expert=args.ep)
+    model = mixtral_config("tiny", max_seq_len=args.seq)
+    on_tpu = jax.default_backend() == "tpu"
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": on_tpu},
+            "gradient_clipping": 1.0,
+            "moe": {"enabled": True, "ep_size": args.ep,
+                    "num_experts": model.num_experts,
+                    "impl": args.impl,
+                    "capacity_factor": args.capacity_factor},
+            "steps_per_print": 5,
+        },
+        rng=jax.random.PRNGKey(0))
+
+    gb = int(engine.config.train_batch_size)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, model.vocab_size,
+                                       size=(gb, args.seq),
+                                       dtype=np.int32)}
+    for step in range(args.steps):
+        loss = float(engine.train_batch(iter([batch])))
+    print(f"moe {args.impl} ep={args.ep} final loss={loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

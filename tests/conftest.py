@@ -17,8 +17,22 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The CPU thunk runtime's concurrency-optimized schedule can execute
+# independent collectives in different orders on different virtual
+# devices; each collective BLOCKS its worker thread until all devices
+# arrive, so on a small host (CI boxes can have ONE core) two reordered
+# collectives deadlock the rendezvous (observed: ZeRO-1 grad allreduce
+# vs a gather, rendezvous.cc "Termination timeout ... exceeded").
+# Force program order, and raise the 20s/40s rendezvous timeouts that
+# otherwise fire spuriously under heavy time-sharing.
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    _flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+if "xla_cpu_collective" not in _flags:
+    _flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+               " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+               " --xla_cpu_collective_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

@@ -11,7 +11,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(args, timeout=280):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # XLA_FLAGS (virtual devices + collective-deadlock guards) are
+    # inherited from os.environ: conftest.py set them before jax loaded
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=timeout)
@@ -76,3 +77,17 @@ def test_finetune_hf_example(tmp_path):
               "--export-dir", str(out)])
     assert r.returncode == 0, r.stderr[-2000:]
     assert (out / "model.safetensors").exists()
+
+
+def test_train_moe_example_ep():
+    r = _run(["examples/train_moe.py", "--ep", "4", "--steps", "2",
+              "--seq", "64"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "moe capacity ep=4" in r.stdout
+
+
+def test_train_moe_example_dropless():
+    r = _run(["examples/train_moe.py", "--impl", "dropless", "--steps",
+              "2", "--seq", "64"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "moe dropless ep=1" in r.stdout

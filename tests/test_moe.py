@@ -354,3 +354,22 @@ def test_dropless_rejects_ep(devices):
     }
     with pytest.raises(ValueError, match="dropless"):
         initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
+
+
+def test_dropless_rejects_pipeline(devices):
+    """dropless + pipeline is a config error (nested shard_map conflict,
+    same restriction as PP+SP)."""
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.runtime.engine import initialize
+
+    build_mesh(data=4, pipe=2)
+    model = mixtral_config("tiny")
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "pipeline": {"stages": 2},
+        "moe": {"enabled": True, "ep_size": 1,
+                "num_experts": model.num_experts, "impl": "dropless"},
+    }
+    with pytest.raises(ValueError, match="dropless"):
+        initialize(model=model, config=cfg, rng=jax.random.PRNGKey(0))
