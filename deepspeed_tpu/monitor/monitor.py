@@ -82,7 +82,7 @@ class CometMonitor(_Writer):
             self.experiment = comet_ml.start(
                 api_key=cfg.api_key or None,
                 workspace=cfg.workspace or None,
-                project_name=cfg.project or None,
+                project=cfg.project or None,
                 mode=cfg.mode or None,
                 online=cfg.online,
                 experiment_key=cfg.experiment_key or None,

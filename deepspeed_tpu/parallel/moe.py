@@ -100,6 +100,31 @@ def topk_gating(logits: jax.Array, k: int, capacity: int,
     return dispatch, combine, aux
 
 
+def _shared_expert(sh, xf: jax.Array) -> jax.Array:
+    """Qwen2-MoE/DeepSeek dense shared expert on every token.
+
+    xf [S,d] → [S,d]; handles int8/fp8 weight_quant leaves (scale-suffix
+    convention, ops/quantized_linear.py) and the optional sigmoid gate.
+    ONE implementation shared by the capacity and dropless paths."""
+    from deepspeed_tpu.ops.quantized_linear import SCALE_SUFFIX
+    if "wg" + SCALE_SUFFIX in sh:
+        from deepspeed_tpu.ops.quantized_linear import qmatmul
+        gate_s = qmatmul(xf, sh["wg"], sh["wg_scale"], out_dtype=xf.dtype)
+        up_s = qmatmul(xf, sh["wi"], sh["wi_scale"], out_dtype=xf.dtype)
+        s_out = qmatmul(jax.nn.silu(gate_s) * up_s, sh["wo"],
+                        sh["wo_scale"], out_dtype=xf.dtype)
+    else:
+        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
+        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
+        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
+                           sh["wo"])
+    if "gate" in sh:
+        s_out = s_out * jax.nn.sigmoid(
+            jnp.einsum("sd,do->so", xf.astype(jnp.float32),
+                       sh["gate"].astype(jnp.float32))).astype(xf.dtype)
+    return s_out
+
+
 def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
                   top_k: int) -> jax.Array:
     """Token-local dropless dispatch: sort + grouped matmul + combine.
@@ -126,16 +151,7 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
     out = jnp.zeros((s, d), xf.dtype).at[tok].add(out_s * w[:, None])
 
     if "shared" in p:   # dense shared expert, same as the capacity path
-        sh = p["shared"]
-        gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
-        up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
-        s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
-                           sh["wo"])
-        if "gate" in sh:
-            s_out = s_out * jax.nn.sigmoid(
-                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
-                           sh["gate"].astype(jnp.float32))).astype(xf.dtype)
-        out = out + s_out
+        out = out + _shared_expert(p["shared"], xf)
     return out
 
 
@@ -211,27 +227,47 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
     return out.reshape(b, t, d), aux * aux_loss_coef
 
 
+#: token count above which dropless beats the capacity dispatch at
+#: serving. The no-drop capacity path builds an [S,E,C=S] dispatch mask —
+#: O(S²·E) — so its cost grows quadratically with prefill size (measured
+#: on a 2.1B 8-expert MoE, one v5e: 2.0x dropless at S=4096, parity at
+#: S≈512–2048, slight capacity edge at decode's S=8 where weight
+#: streaming dominates and ragged_dot's dynamic grouping breaks fusion).
+DROPLESS_MIN_TOKENS = 1024
+
+
 def serving_moe_fn(model, weight_quant, params, ep: bool):
     """The ONE selection point for both inference engines' ``moe_fn``.
 
     Serving routes every token deterministically (full capacity, no
     dropping — reference MoE inference EP, inference/engine.py:260).
-    Dropless is the fast path (S·k instead of E·S expert-token FLOPs)
-    but reads raw weight leaves, so quantized expert weights (startup
-    ``weight_quant`` OR a pre-quantized dstpu_quantize tree) and EP>1
-    (expert-sharded capacity buffers) fall back to the capacity path's
-    scale-aware qmatmul dispatch.
+    Dropless is the fast path for large token counts (linear dispatch
+    vs the capacity path's quadratic [S,E,S] mask) but reads raw weight
+    leaves, so quantized expert weights (startup ``weight_quant`` OR a
+    pre-quantized dstpu_quantize tree) and EP>1 (expert-sharded
+    capacity buffers) always use the capacity path's scale-aware
+    qmatmul dispatch. Token count is static at trace time, so the
+    prefill shapes jit through dropless and the decode shapes through
+    capacity — each engine's shape-keyed jit cache keeps both.
     """
     from deepspeed_tpu.inference.engine import _is_quantized_tree
     quantized = bool(weight_quant) or _is_quantized_tree(params)
-    if not ep and not quantized:
-        return partial(dropless_moe_layer,
-                       top_k=model.num_experts_per_tok,
-                       aux_loss_coef=0.0, norm_topk=model.norm_topk_prob)
-    return partial(moe_layer, top_k=model.num_experts_per_tok,
-                   drop_tokens=False, aux_loss_coef=0.0,
-                   ep_axis="expert" if ep else None,
-                   norm_topk=model.norm_topk_prob)
+    capacity_fn = partial(moe_layer, top_k=model.num_experts_per_tok,
+                          drop_tokens=False, aux_loss_coef=0.0,
+                          ep_axis="expert" if ep else None,
+                          norm_topk=model.norm_topk_prob)
+    if ep or quantized:
+        return capacity_fn
+    dropless_fn = partial(dropless_moe_layer,
+                          top_k=model.num_experts_per_tok,
+                          aux_loss_coef=0.0,
+                          norm_topk=model.norm_topk_prob)
+
+    def by_token_count(cfg, p, x, **kw):
+        if x.shape[0] * x.shape[1] >= DROPLESS_MIN_TOKENS:
+            return dropless_fn(cfg, p, x, **kw)
+        return capacity_fn(cfg, p, x, **kw)
+    return by_token_count
 
 
 def moe_layer(cfg, p, x: jax.Array,
@@ -312,23 +348,5 @@ def moe_layer(cfg, p, x: jax.Array,
     out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_buf)
 
     if "shared" in p:   # Qwen2-MoE/DeepSeek: dense expert on every token
-        sh = p["shared"]
-        if "wg" + SCALE_SUFFIX in sh:
-            from deepspeed_tpu.ops.quantized_linear import qmatmul
-            gate_s = qmatmul(xf, sh["wg"], sh["wg_scale"],
-                             out_dtype=xf.dtype)
-            up_s = qmatmul(xf, sh["wi"], sh["wi_scale"],
-                           out_dtype=xf.dtype)
-            s_out = qmatmul(jax.nn.silu(gate_s) * up_s, sh["wo"],
-                            sh["wo_scale"], out_dtype=xf.dtype)
-        else:
-            gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
-            up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
-            s_out = jnp.einsum("sh,hd->sd", jax.nn.silu(gate_s) * up_s,
-                               sh["wo"])
-        if "gate" in sh:
-            s_out = s_out * jax.nn.sigmoid(
-                jnp.einsum("sd,do->so", xf.astype(jnp.float32),
-                           sh["gate"].astype(jnp.float32))).astype(x.dtype)
-        out = out + s_out
+        out = out + _shared_expert(p["shared"], xf)
     return out.reshape(b, t, d), aux * aux_loss_coef

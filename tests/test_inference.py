@@ -215,3 +215,41 @@ def test_v1_fused_generate_matches_stepwise(devices, monkeypatch):
     hits = np.where(row == fake_eos)[0]
     assert len(hits) > 0
     assert (row[hits[0]:] == fake_eos).all()
+
+
+def test_serving_moe_hybrid_dispatch(devices):
+    """Serving MoE picks dropless for prefill-sized token counts and
+    capacity for decode-sized ones (trace-time shape switch), and the
+    mixed pipeline still matches the training forward token-for-token."""
+    from functools import partial
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models.transformer import forward, init_params
+    from deepspeed_tpu.parallel.moe import (DROPLESS_MIN_TOKENS,
+                                            moe_layer, serving_moe_fn)
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = mixtral_config("tiny", max_seq_len=DROPLESS_MIN_TOKENS // 4 + 32,
+                         vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    eng = init_inference(cfg, {"dtype": "float32",
+                               "max_out_tokens": cfg.max_seq_len},
+                         params=params)
+    # batch 4 x long prompt: prefill S >= DROPLESS_MIN_TOKENS (dropless),
+    # decode S = 4 (capacity)
+    plen = DROPLESS_MIN_TOKENS // 4
+    prompts = np.random.default_rng(5).integers(
+        0, 256, size=(4, plen), dtype=np.int32)
+    out = eng.generate(prompts, max_new_tokens=3)
+    # greedy reference decode via the training forward (full capacity)
+    moe = partial(moe_layer, top_k=cfg.num_experts_per_tok,
+                  drop_tokens=False, aux_loss_coef=0.0, ep_axis=None)
+    seq = prompts.copy()
+    for _ in range(3):
+        logits = forward(cfg, params, jnp.asarray(seq), moe_fn=moe)
+        nxt = np.argmax(np.asarray(logits[:, -1]), axis=-1)
+        seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), seq)
+    # the selection helper returns the hybrid only when eligible
+    fn = serving_moe_fn(cfg, None, params, ep=False)
+    assert fn.__name__ == "by_token_count"
+    fn_q = serving_moe_fn(cfg, "int8", params, ep=False)
+    assert getattr(fn_q, "func", None) is moe_layer
