@@ -85,10 +85,11 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
              "float16": jnp.float16}[config.dtype]
     tp = mesh.shape["model"] > 1
-    if config.weight_quant and tp:
+    if config.weight_quant in ("int4", "fp6") and tp:
         raise ValueError(
             f"weight_quant={config.weight_quant} requires tp_size=1 / a "
-            "mesh with model axis 1 (quantized leaves are not TP-sharded)")
+            "mesh with model axis 1: the packed nibble/6-bit planes "
+            "cannot be sharded (int8/fp8 DO support TP via qmatmul_tp)")
     if config.weight_quant and model.num_experts and \
             mesh.shape["expert"] > 1:
         raise ValueError(
@@ -116,10 +117,15 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
         # lm_head_q leaves don't match the partition-spec pytree, and
         # quantized leaves only serve unsharded anyway (same restriction
         # as weight_quant) — replicate onto the mesh leaf-wise
-        if tp:
+        if tp and any(
+                v.dtype == jnp.uint8 for v in jax.tree.leaves(params)
+                if hasattr(v, "dtype")):
             raise ValueError(
-                "pre-quantized params require tp_size=1 / a mesh with "
-                "model axis 1 (quantized leaves are not TP-sharded)")
+                "pre-quantized packed (int4/fp6) params require "
+                "tp_size=1 / a mesh with model axis 1: the packed "
+                "nibble/6-bit planes cannot be sharded. Pre-quantized "
+                "int8/fp8 trees DO serve under TP (qmatmul_tp reshards "
+                "the replicated leaves per matmul)")
         if model.num_experts and mesh.shape["expert"] > 1:
             raise ValueError(
                 "pre-quantized MoE params require an expert mesh axis "

@@ -230,6 +230,16 @@ class RaggedInferenceEngineTPU:
         self.config = config
         from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
         validate_weight_quant(config.weight_quant)
+        if config.weight_quant:
+            from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+            if has_mesh() and get_mesh().shape.get("model", 1) > 1:
+                raise ValueError(
+                    "RaggedInferenceEngineTPU is single-shard: quantized "
+                    "linears route through qmatmul_tp, which would "
+                    "shard_map over the ambient mesh's model axis "
+                    f"(size {get_mesh().shape['model']}). Build a mesh "
+                    "with model=1 for the ragged engine, or use "
+                    "InferenceEngineTPU for TP serving.")
         self.dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                       "float16": jnp.float16}[config.dtype]
         if config.use_pallas is None:

@@ -435,9 +435,12 @@ def linear_2d(x: jax.Array, p: Params, name: str) -> jax.Array:
     w = p[name]
     if name + "_scale" not in p:
         return jnp.einsum("...k,kn->...n", x, w)
-    from deepspeed_tpu.ops.quantized_linear import qmatmul
+    from deepspeed_tpu.ops.quantized_linear import qmatmul_tp
     lead = x.shape[:-1]
-    out = qmatmul(x.reshape(-1, x.shape[-1]), w, p[name + "_scale"])
+    # TP roles mirror partition_specs: out-projections ("wo") are
+    # row-parallel, everything else column-parallel
+    out = qmatmul_tp(x.reshape(-1, x.shape[-1]), w, p[name + "_scale"],
+                     role="row" if name == "wo" else "col")
     return out.reshape(*lead, w.shape[-1])
 
 
@@ -742,11 +745,11 @@ def lm_logits(cfg: DecoderConfig, params: Params, x: jax.Array,
     q_name = "lm_head_q" if "lm_head_q" in params else \
         ("lm_head" if "lm_head_scale" in params else None)
     if q_name:   # int8 serving head (tied models carry a transposed copy)
-        from deepspeed_tpu.ops.quantized_linear import qmatmul
+        from deepspeed_tpu.ops.quantized_linear import qmatmul_tp
         b, t, d = x.shape
-        logits = qmatmul(x.reshape(b * t, d), params[q_name],
-                         params[q_name + "_scale"],
-                         out_dtype=jnp.float32).reshape(b, t, -1)
+        logits = qmatmul_tp(x.reshape(b * t, d), params[q_name],
+                            params[q_name + "_scale"], role="col",
+                            out_dtype=jnp.float32).reshape(b, t, -1)
         if "lm_head_bias" in params:
             logits = logits + params["lm_head_bias"].astype(jnp.float32)
     elif cfg.tie_embeddings:

@@ -230,7 +230,7 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
 #: token count above which dropless beats the capacity dispatch at
 #: serving. The no-drop capacity path builds an [S,E,C=S] dispatch mask —
 #: O(S²·E) — so its cost grows quadratically with prefill size (measured
-#: on a 2.1B 8-expert MoE, one v5e: 2.0x dropless at S=4096, parity at
+#: on a 1.15B 8-expert MoE, one v5e: 2.0x dropless at S=4096, parity at
 #: S≈512–2048, slight capacity edge at decode's S=8 where weight
 #: streaming dominates and ragged_dot's dynamic grouping breaks fusion).
 DROPLESS_MIN_TOKENS = 1024

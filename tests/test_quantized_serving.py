@@ -242,7 +242,8 @@ def test_quantize_param_tree_rejects_double_apply(devices, mode, tied):
         quantize_param_tree(qp, mode=mode)
 
 
-def test_weight_quant_rejects_tp(devices):
+def test_weight_quant_packed_rejects_tp(devices):
+    """Packed int4/fp6 planes cannot shard; int8/fp8 CAN (qmatmul_tp)."""
     from deepspeed_tpu.parallel.mesh import build_mesh
     from deepspeed_tpu.inference.engine import InferenceEngineTPU
     from deepspeed_tpu.models.llama import llama3_config
@@ -250,9 +251,44 @@ def test_weight_quant_rejects_tp(devices):
     cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
     with pytest.raises(ValueError, match="tp_size=1"):
         InferenceEngineTPU(cfg, {"dtype": "float32",
-                                 "weight_quant": "int8",
+                                 "weight_quant": "int4",
                                  "tensor_parallel": {"tp_size": 2}},
                            rng=jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_weight_quant_tp_matches_tp1(devices, mode):
+    """TP=2 quantized serving (reference: module_inject INT8 with
+    mp_size>1): full-model logits agree with TP=1 to fp tolerance
+    (the TP path psums per-shard partials, so reduction order differs
+    — logits comparison, not bitwise token equality), and generation
+    runs end-to-end."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models.transformer import forward, init_params
+
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    prompt = np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0)
+    tokens = jnp.asarray(prompt)
+
+    def logits_and_gen(tp):
+        build_mesh(data=8 // tp, model=tp)
+        eng = InferenceEngineTPU(
+            cfg, {"dtype": "float32", "weight_quant": mode,
+                  "max_out_tokens": 32,
+                  "tensor_parallel": {"tp_size": tp}},
+            params=params)
+        lg = np.asarray(forward(cfg, eng.params, tokens))
+        out = np.asarray(eng.generate(prompt, max_new_tokens=6,
+                                      temperature=0.0))
+        assert out.shape == (2, 14)
+        return lg
+
+    l2 = logits_and_gen(2)
+    l1 = logits_and_gen(1)
+    np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-4)
 
 
 def test_qmatmul_batched_matches_dequant_reference():
@@ -354,3 +390,46 @@ def test_weight_quant_invalid_mode_fails_fast(devices):
         InferenceEngineTPU(cfg, {"weight_quant": "int3"})
     with pytest.raises(ValueError, match="'int4'"):
         RaggedInferenceEngineTPU(cfg, {"weight_quant": "fp4"})
+
+
+def test_ragged_engine_rejects_ambient_tp_mesh_with_quant(devices):
+    """The single-shard ragged engine must not silently shard_map its
+    quantized linears over an ambient model axis."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    build_mesh(data=4, model=2)
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    with pytest.raises(ValueError, match="single-shard"):
+        RaggedInferenceEngineTPU(cfg, {"dtype": "float32",
+                                       "weight_quant": "int8",
+                                       "num_blocks": 8, "block_size": 16},
+                                 rng=jax.random.PRNGKey(0))
+
+
+def test_prequantized_int8_serves_under_tp(devices):
+    """Pre-quantized int8 trees (dstpu_quantize output shape) serve on
+    a TP mesh — replicated leaves, qmatmul_tp reshards per matmul —
+    matching the TP=1 pre-quantized logits; packed int4 still rejects."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models.transformer import forward, init_params
+
+    cfg = llama3_config("tiny", max_seq_len=64, vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(9))
+    qp = quantize_param_tree(params, mode="int8")
+    tokens = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+
+    def logits(tp):
+        build_mesh(data=8 // tp, model=tp)
+        eng = InferenceEngineTPU(cfg, {"dtype": "float32"}, params=qp)
+        return np.asarray(forward(cfg, eng.params, tokens))
+
+    np.testing.assert_allclose(logits(2), logits(1), rtol=2e-4,
+                               atol=2e-4)
+
+    qp4 = quantize_param_tree(params, mode="int4")
+    build_mesh(data=4, model=2)
+    with pytest.raises(ValueError, match="packed"):
+        InferenceEngineTPU(cfg, {"dtype": "float32"}, params=qp4)
