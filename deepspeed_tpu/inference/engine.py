@@ -90,15 +90,13 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
             f"weight_quant={config.weight_quant} requires tp_size=1 / a "
             "mesh with model axis 1: the packed nibble/6-bit planes "
             "cannot be sharded (int8/fp8 DO support TP via qmatmul_tp)")
-    if config.weight_quant and model.num_experts and \
+    if config.weight_quant in ("int4", "fp6") and model.num_experts and \
             mesh.shape["expert"] > 1:
         raise ValueError(
             f"weight_quant={config.weight_quant} requires an expert "
-            "mesh axis of 1: GSPMD replicates the opaque grouped "
-            "dequant kernel, silently losing both the EP sharding and "
-            "the memory halving — quantized MoE serving is a "
-            "single-chip capacity feature (same precedent as the TP "
-            "restriction above)")
+            "mesh axis of 1: the packed nibble/6-bit expert planes "
+            "cannot shard over EP (int8/fp8 DO support EP via "
+            "qmatmul_batched_ep)")
     specs = partition_specs(model, zero_stage=0, tp=tp)
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
@@ -126,12 +124,14 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
                 "nibble/6-bit planes cannot be sharded. Pre-quantized "
                 "int8/fp8 trees DO serve under TP (qmatmul_tp reshards "
                 "the replicated leaves per matmul)")
-        if model.num_experts and mesh.shape["expert"] > 1:
+        if model.num_experts and mesh.shape["expert"] > 1 and any(
+                v.dtype == jnp.uint8 for v in jax.tree.leaves(params)
+                if hasattr(v, "dtype")):
             raise ValueError(
-                "pre-quantized MoE params require an expert mesh axis "
-                "of 1 (same restriction as weight_quant: the grouped "
-                "dequant kernel would be replicated, silently losing EP "
-                "and the memory win)")
+                "pre-quantized packed (int4/fp6) MoE params require an "
+                "expert mesh axis of 1: the packed expert planes cannot "
+                "shard over EP. Pre-quantized int8/fp8 MoE trees DO "
+                "serve under EP (qmatmul_batched_ep)")
         if config.weight_quant:
             raise ValueError(
                 "params are already quantized (scale leaves present); "

@@ -230,13 +230,23 @@ class RaggedInferenceEngineTPU:
         self.config = config
         from deepspeed_tpu.ops.quantized_linear import validate_weight_quant
         validate_weight_quant(config.weight_quant)
-        if config.weight_quant:
-            from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
-            if has_mesh() and get_mesh().shape.get("model", 1) > 1:
+        from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+        if has_mesh() and get_mesh().shape.get("model", 1) > 1:
+            # only UNPACKED quantization shards (qmatmul_tp); packed
+            # int4/fp6 always run replicated, so they stay legal here.
+            # Check the param tree too: pre-quantized dstpu_quantize
+            # trees arrive with weight_quant unset.
+            from deepspeed_tpu.inference.engine import _is_quantized_tree
+            unpacked_q = config.weight_quant in ("int8", "fp8") or (
+                params is not None and _is_quantized_tree(params)
+                and not any(
+                    getattr(v, "dtype", None) == jnp.uint8
+                    for v in jax.tree.leaves(params)))
+            if unpacked_q:
                 raise ValueError(
-                    "RaggedInferenceEngineTPU is single-shard: quantized "
-                    "linears route through qmatmul_tp, which would "
-                    "shard_map over the ambient mesh's model axis "
+                    "RaggedInferenceEngineTPU is single-shard: int8/fp8 "
+                    "quantized linears route through qmatmul_tp, which "
+                    "would shard_map over the ambient mesh's model axis "
                     f"(size {get_mesh().shape['model']}). Build a mesh "
                     "with model=1 for the ragged engine, or use "
                     "InferenceEngineTPU for TP serving.")

@@ -462,26 +462,37 @@ def qmatmul_tp(x: jax.Array, w_q: jax.Array, scale: jax.Array,
             f"tp={tp}; running replicated")
         return qmatmul(x, w_q, scale, out_dtype=out_dtype)
     out_dtype = out_dtype or x.dtype
+    return _qtp_fn(mesh, role, jnp.dtype(out_dtype))(x, w_q, scale)
 
+
+def _qtp_col_body(xl, wl, sl, out_dtype):
+    return qmatmul(xl, wl, sl, out_dtype=out_dtype)
+
+
+def _qtp_row_body(xl, wl, sl, out_dtype):
+    return lax.psum(qmatmul(xl, wl, sl, out_dtype=out_dtype), "model")
+
+
+@functools.lru_cache(maxsize=64)
+def _qtp_fn(mesh, role, out_dtype):
+    """Cached jitted shard_map per (mesh, role, out_dtype) — a fresh
+    closure per call would defeat the jit cache for eager callers
+    (function identity keys the cache; shapes still retrace within one
+    entry as usual)."""
     if role == "col":
         in_specs = (P(None, None), P(None, "model"), P("model"))
         out_spec = P(None, "model")
-
-        def body(xl, wl, sl):
-            return qmatmul(xl, wl, sl, out_dtype=out_dtype)
+        body = functools.partial(_qtp_col_body, out_dtype=out_dtype)
     else:
         in_specs = (P(None, "model"), P("model", None), P(None))
         out_spec = P(None, None)
-
-        def body(xl, wl, sl):
-            return lax.psum(qmatmul(xl, wl, sl, out_dtype=out_dtype),
-                            "model")
+        body = functools.partial(_qtp_row_body, out_dtype=out_dtype)
     fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_spec, axis_names={"model"},
                        check_vma=False)
     # jit wrapper: partial-manual shard_map needs a jit context (eager
     # calls fail spec validation); under an outer jit this is inlined
-    return jax.jit(fn)(x, w_q, scale)
+    return jax.jit(fn)
 
 
 def _qmm_batched_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
@@ -500,6 +511,42 @@ def _qmm_batched_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
     @pl.when(k == nk - 1)
     def _flush():
         o_ref[0] = (acc_ref[...] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
+
+
+def qmatmul_batched_ep(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                       out_dtype=None) -> jax.Array:
+    """EP-sharded grouped weight-only matmul: the batched Pallas kernel
+    under a partial shard_map over the 'expert' axis (the reference's
+    cutlass grouped moe_gemm runs per EP rank the same way).
+
+    The group dim G is embarrassingly parallel — each expert shard runs
+    the kernel on its local experts' weights and capacity buffers, no
+    reduction needed. Falls back to the plain (replicated) kernel when
+    no mesh / expert axis 1, packed int4/fp6 weights, or G not
+    divisible by the expert axis.
+    """
+    from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+    mesh = get_mesh() if has_mesh() else None
+    ep = mesh.shape.get("expert", 1) if mesh is not None else 1
+    g = x.shape[0]
+    if ep == 1 or w_q.dtype == jnp.uint8 or g % ep:
+        if ep > 1:
+            logger.warning(
+                f"qmatmul_batched_ep: G={g} dtype={w_q.dtype} not "
+                f"EP-shardable over expert={ep}; running replicated")
+        return qmatmul_batched(x, w_q, scale, out_dtype=out_dtype)
+    return _qbe_fn(mesh, jnp.dtype(out_dtype or x.dtype))(x, w_q, scale)
+
+
+@functools.lru_cache(maxsize=32)
+def _qbe_fn(mesh, out_dtype):
+    """Cached jitted shard_map for the EP grouped kernel (see _qtp_fn)."""
+    spec3 = P("expert", None, None)
+    fn = jax.shard_map(
+        functools.partial(qmatmul_batched, out_dtype=out_dtype),
+        mesh=mesh, in_specs=(spec3, spec3, P("expert", None)),
+        out_specs=spec3, axis_names={"expert"}, check_vma=False)
+    return jax.jit(fn)
 
 
 def qmatmul_batched(x: jax.Array, w_q: jax.Array, scale: jax.Array,

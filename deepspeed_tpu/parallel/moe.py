@@ -108,11 +108,18 @@ def _shared_expert(sh, xf: jax.Array) -> jax.Array:
     ONE implementation shared by the capacity and dropless paths."""
     from deepspeed_tpu.ops.quantized_linear import SCALE_SUFFIX
     if "wg" + SCALE_SUFFIX in sh:
-        from deepspeed_tpu.ops.quantized_linear import qmatmul
-        gate_s = qmatmul(xf, sh["wg"], sh["wg_scale"], out_dtype=xf.dtype)
-        up_s = qmatmul(xf, sh["wi"], sh["wi_scale"], out_dtype=xf.dtype)
-        s_out = qmatmul(jax.nn.silu(gate_s) * up_s, sh["wo"],
-                        sh["wo_scale"], out_dtype=xf.dtype)
+        # qmatmul_tp so int8/fp8 shared-expert weights TP-shard like the
+        # dense MLP (col gate/up, row down); only reached from the
+        # capacity path — dropless is unquantized by construction, so
+        # no nested-manual-mesh conflict with its batch shard_map
+        from deepspeed_tpu.ops.quantized_linear import qmatmul_tp
+        gate_s = qmatmul_tp(xf, sh["wg"], sh["wg_scale"], role="col",
+                            out_dtype=xf.dtype)
+        up_s = qmatmul_tp(xf, sh["wi"], sh["wi_scale"], role="col",
+                          out_dtype=xf.dtype)
+        s_out = qmatmul_tp(jax.nn.silu(gate_s) * up_s, sh["wo"],
+                           sh["wo_scale"], role="row",
+                           out_dtype=xf.dtype)
     else:
         gate_s = jnp.einsum("sd,dh->sh", xf, sh["wg"])
         up_s = jnp.einsum("sd,dh->sh", xf, sh["wi"])
@@ -322,19 +329,20 @@ def moe_layer(cfg, p, x: jax.Array,
     # wg_scale leaf (ops/quantized_linear.py suffix convention, attached
     # by the engines' weight_quant config) routes the grouped matmuls
     # through the Pallas batched dequant kernel — int8/fp8 expert
-    # weights at half the HBM (serving-only; under EP>1 the opaque
-    # kernel is replicated by GSPMD, so quantized MoE serving is meant
-    # for single-chip capacity, like the TP restriction)
+    # weights at half the HBM (serving-only). Under EP>1
+    # qmatmul_batched_ep shard_maps the kernel over 'expert' so each
+    # shard streams only its local experts' weights (packed int4/fp6
+    # stay single-shard, as does the engine guard for them).
     from deepspeed_tpu.ops.quantized_linear import SCALE_SUFFIX
     if "wg" + SCALE_SUFFIX in p:
-        from deepspeed_tpu.ops.quantized_linear import qmatmul_batched
-        gate = qmatmul_batched(buf, p["wg"], p["wg_scale"],
-                               out_dtype=buf.dtype)
-        up = qmatmul_batched(buf, p["wi"], p["wi_scale"],
-                             out_dtype=buf.dtype)
-        hidden = jax.nn.silu(gate) * up
-        out_buf = qmatmul_batched(hidden, p["wo"], p["wo_scale"],
+        from deepspeed_tpu.ops.quantized_linear import qmatmul_batched_ep
+        gate = qmatmul_batched_ep(buf, p["wg"], p["wg_scale"],
                                   out_dtype=buf.dtype)
+        up = qmatmul_batched_ep(buf, p["wi"], p["wi_scale"],
+                                out_dtype=buf.dtype)
+        hidden = jax.nn.silu(gate) * up
+        out_buf = qmatmul_batched_ep(hidden, p["wo"], p["wo_scale"],
+                                     out_dtype=buf.dtype)
     else:
         gate = jnp.einsum("ecd,edh->ech", buf, p["wg"])
         up = jnp.einsum("ecd,edh->ech", buf, p["wi"])

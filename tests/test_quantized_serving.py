@@ -349,9 +349,9 @@ def test_quantized_moe_forward_close_to_float(devices, mode):
     assert cos > (0.97 if mode in ("int4", "fp6") else 0.99), cos
 
 
-def test_weight_quant_rejects_ep(devices):
-    """Quantized MoE on an expert>1 mesh must fail fast (GSPMD would
-    replicate the grouped kernel, silently losing EP + the memory win)."""
+def test_weight_quant_packed_rejects_ep(devices):
+    """Packed int4/fp6 expert planes cannot shard over EP; int8/fp8 CAN
+    (qmatmul_batched_ep)."""
     from deepspeed_tpu.parallel.mesh import build_mesh
     from deepspeed_tpu.inference.engine import InferenceEngineTPU
     from deepspeed_tpu.models.mixtral import mixtral_config
@@ -359,8 +359,37 @@ def test_weight_quant_rejects_ep(devices):
     cfg = mixtral_config("tiny")
     with pytest.raises(ValueError, match="expert"):
         InferenceEngineTPU(cfg, {"dtype": "float32",
-                                 "weight_quant": "int8"},
+                                 "weight_quant": "int4"},
                            rng=jax.random.PRNGKey(0))
+
+
+def test_quantized_moe_ep_matches_ep1(devices):
+    """int8 quantized MoE serving over EP=4 (qmatmul_batched_ep shard
+    over 'expert') produces the same logits as EP=1."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine import InferenceEngineTPU
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models.transformer import forward, init_params
+    from functools import partial
+    from deepspeed_tpu.parallel.moe import moe_layer
+
+    cfg = mixtral_config("tiny", max_seq_len=64, vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    tokens = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+
+    def logits(ep):
+        build_mesh(data=8 // ep, expert=ep)
+        eng = InferenceEngineTPU(cfg, {"dtype": "float32",
+                                       "weight_quant": "int8"},
+                                 params=params)
+        moe = partial(moe_layer, top_k=cfg.num_experts_per_tok,
+                      drop_tokens=False, aux_loss_coef=0.0,
+                      ep_axis="expert" if ep > 1 else None)
+        return np.asarray(jax.jit(partial(forward, cfg, moe_fn=moe))(
+            eng.params, tokens))
+
+    np.testing.assert_allclose(logits(4), logits(1), rtol=2e-4,
+                               atol=2e-4)
 
 
 def test_quantized_moe_v1_engine_generates(devices):
