@@ -72,6 +72,15 @@ def _is_quantized_tree(params) -> bool:
     return isinstance(params, dict) and walk(params)
 
 
+def _has_packed_leaves(params) -> bool:
+    """True when the tree carries PACKED quantized leaves (int4 nibble /
+    fp6 plane storage, uint8 dtype) — the formats whose planes cannot
+    be TP/EP-sharded. int8 leaves are ``int8``, fp8 are
+    ``float8_e4m3fn``; only packed formats use uint8."""
+    return any(getattr(v, "dtype", None) == jnp.uint8
+               for v in jax.tree.leaves(params))
+
+
 def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
     """Shared serving-engine bring-up (v1 generator + encoder engine):
     mesh resolution, dtype policy, TP/EP weight-quant guards, GSPMD
@@ -115,18 +124,15 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
         # lm_head_q leaves don't match the partition-spec pytree, and
         # quantized leaves only serve unsharded anyway (same restriction
         # as weight_quant) — replicate onto the mesh leaf-wise
-        if tp and any(
-                v.dtype == jnp.uint8 for v in jax.tree.leaves(params)
-                if hasattr(v, "dtype")):
+        if tp and _has_packed_leaves(params):
             raise ValueError(
                 "pre-quantized packed (int4/fp6) params require "
                 "tp_size=1 / a mesh with model axis 1: the packed "
                 "nibble/6-bit planes cannot be sharded. Pre-quantized "
                 "int8/fp8 trees DO serve under TP (qmatmul_tp reshards "
                 "the replicated leaves per matmul)")
-        if model.num_experts and mesh.shape["expert"] > 1 and any(
-                v.dtype == jnp.uint8 for v in jax.tree.leaves(params)
-                if hasattr(v, "dtype")):
+        if model.num_experts and mesh.shape["expert"] > 1 and \
+                _has_packed_leaves(params):
             raise ValueError(
                 "pre-quantized packed (int4/fp6) MoE params require an "
                 "expert mesh axis of 1: the packed expert planes cannot "

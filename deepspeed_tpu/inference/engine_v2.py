@@ -236,12 +236,11 @@ class RaggedInferenceEngineTPU:
             # int4/fp6 always run replicated, so they stay legal here.
             # Check the param tree too: pre-quantized dstpu_quantize
             # trees arrive with weight_quant unset.
-            from deepspeed_tpu.inference.engine import _is_quantized_tree
+            from deepspeed_tpu.inference.engine import (
+                _has_packed_leaves, _is_quantized_tree)
             unpacked_q = config.weight_quant in ("int8", "fp8") or (
                 params is not None and _is_quantized_tree(params)
-                and not any(
-                    getattr(v, "dtype", None) == jnp.uint8
-                    for v in jax.tree.leaves(params)))
+                and not _has_packed_leaves(params))
             if unpacked_q:
                 raise ValueError(
                     "RaggedInferenceEngineTPU is single-shard: int8/fp8 "

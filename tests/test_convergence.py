@@ -1,0 +1,71 @@
+"""Real convergence tests (reference analogue: tests/model/ BERT
+convergence runs — scaled to a memorization task that must reach
+near-zero loss, not just decrease)."""
+
+import numpy as np
+import jax
+
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+
+def test_llama_memorizes_batch(devices):
+    """ZeRO-1 bf16-off training drives a fixed batch from random-init
+    loss (~ln V) to near-zero — exercises the full engine loop (fused
+    step, scheduler, grad clip) well past the first few steps."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+
+    build_mesh(data=8)
+    model = llama3_config("tiny", max_seq_len=32, vocab_size=128)
+    eng, _, _, sched = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_max_lr": 5e-3,
+                                 "warmup_num_steps": 5}},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+    }, rng=jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    first = float(eng.train_batch(iter([batch])))
+    assert 3.5 < first < 6.5, first          # ~ln(128)=4.85 at init
+    loss = first
+    for _ in range(59):
+        loss = float(eng.train_batch(iter([batch])))
+    assert loss < 0.15, f"failed to memorize: {loss} (from {first})"
+
+    # eval on the training batch agrees with the final train loss scale
+    ev = float(eng.eval_batch(iter([batch])))
+    assert ev < 0.2, ev
+
+
+def test_moe_dropless_memorizes_batch(devices):
+    """The dropless routing path also converges to near-zero — router
+    gradients through the gate weights are real, not just nonzero."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mixtral import mixtral_config
+
+    build_mesh(data=8)
+    model = mixtral_config("tiny", max_seq_len=32, vocab_size=128)
+    eng, *_ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "moe": {"enabled": True, "ep_size": 1,
+                "num_experts": model.num_experts, "impl": "dropless"},
+        "steps_per_print": 1000,
+    }, rng=jax.random.PRNGKey(1))
+
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    first = float(eng.train_batch(iter([batch])))
+    loss = first
+    for _ in range(59):
+        loss = float(eng.train_batch(iter([batch])))
+    # MoE keeps the aux load-balance term in the reported loss; the CE
+    # part must be memorized away
+    assert loss < 0.3, f"failed to memorize: {loss} (from {first})"
