@@ -105,3 +105,51 @@ def test_tp_autotp_merge():
 def test_is_auto():
     assert is_auto("auto") and is_auto("AUTO")
     assert not is_auto(4) and not is_auto("x")
+
+
+def test_add_config_arguments_parity():
+    """Reference deepspeed/__init__.py:279 flag names parse unchanged."""
+    import argparse
+    import deepspeed_tpu as ds
+    p = ds.add_config_arguments(argparse.ArgumentParser())
+    a = p.parse_args(["--deepspeed", "--deepspeed_config", "cfg.json"])
+    assert a.deepspeed and a.deepspeed_config == "cfg.json"
+    a2 = p.parse_args([])
+    assert not a2.deepspeed and a2.deepspeed_config is None
+    a3 = p.parse_args(["--deepscale", "--deepscale_config", "c.json"])
+    assert a3.deepscale and a3.deepscale_config == "c.json"
+
+
+def test_default_inference_config():
+    import deepspeed_tpu as ds
+    d = ds.default_inference_config()
+    assert isinstance(d, dict)
+    assert d["dtype"] in ("bfloat16", "float32", "float16")
+    assert "max_out_tokens" in d and "tensor_parallel" in d
+
+
+def test_tp_model_init(devices):
+    """tp_model_init returns params born TP-sharded (reference
+    deepspeed/__init__.py:380) — no unsharded materialization — and
+    refuses to silently replace a conflicting live mesh."""
+    import pytest
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+
+    cfg = llama3_config("tiny", max_seq_len=32)
+    ds.build_mesh(data=4, model=2)
+    params, mesh = ds.tp_model_init(cfg, tp_size=2, dtype="bfloat16")
+    assert mesh.shape["model"] == 2
+    wq = params["layers"]["attn"]["wq"]
+    assert wq.dtype == jnp.bfloat16
+    assert "model" in str(wq.sharding.spec)
+    wo = params["layers"]["attn"]["wo"]
+    assert "model" in str(wo.sharding.spec)   # row-parallel input dim
+    # fp16 short alias accepted
+    p16, _ = ds.tp_model_init(cfg, tp_size=2, dtype="fp16")
+    assert p16["layers"]["attn"]["wq"].dtype == jnp.float16
+    # conflicting live mesh -> explicit error, mesh untouched
+    with pytest.raises(ValueError, match="live mesh"):
+        ds.tp_model_init(cfg, tp_size=4, dtype="bfloat16")
+    assert ds.get_mesh().shape["model"] == 2
