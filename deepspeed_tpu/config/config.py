@@ -249,6 +249,10 @@ class MoEConfig(TPUConfigModel):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    #: Residual-MoE (PR-MoE's residual half, reference moe/layer.py
+    #: use_residual): each MoE layer also runs a dense MLP, mixed with
+    #: the routed output by a learned per-token 2-way softmax
+    use_residual: bool = False
     aux_loss_coef: float = 0.01
     # "capacity": GShard einsum dispatch with static capacity (the
     # reference's only mode; required for ep_size > 1). "dropless":

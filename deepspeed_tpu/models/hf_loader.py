@@ -1512,6 +1512,17 @@ def export_hf_checkpoint(cfg: DecoderConfig, params: Params,
     (single shard) + config.json — the reverse mapping, so models trained
     here load in transformers."""
     import jax
+    # also key on the params tree: the moe.use_residual config knob folds
+    # moe_residual into an internal copy of the model config, so the
+    # caller's cfg may still say False while the tree carries the branch
+    if cfg.moe_residual or (isinstance(params.get("layers"), dict)
+                            and "residual" in params["layers"].get(
+                                "moe", {})):
+        raise ValueError(
+            "export_hf_checkpoint: Residual-MoE (moe_residual) is a "
+            "DeepSpeed training feature with no HF layout slot for the "
+            "dense branch / coefficient — no transformers architecture "
+            "can load it")
     if not cfg.causal or not cfg.prenorm:
         return _export_encoder(cfg, config_to_hf(cfg), params, out_dir)
     if _is_neox_layout(cfg):

@@ -90,6 +90,12 @@ class DecoderConfig:
     shared_expert_size: int = 0
     #: sigmoid(x @ gate) scaling on the shared expert output (Qwen2-MoE)
     shared_expert_gate: bool = False
+    #: DeepSpeed Residual-MoE (PR-MoE's "R"; reference moe/layer.py
+    #: use_residual): every MoE layer also runs a DENSE MLP and the two
+    #: outputs are mixed by a learned per-token 2-way softmax coefficient
+    #: — out = moe·c₀ + mlp·c₁. Unlike the shared expert (additive,
+    #: Qwen2-MoE) the mixture is convex and learned per token.
+    moe_residual: bool = False
     # initializer
     init_std: float = 0.02
     #: decoupled head dim (Gemma head_dim=256 with H*Dh != hidden);
@@ -204,10 +210,13 @@ class DecoderConfig:
         else:
             mlp = 2 * d * h
         if self.num_experts:
+            dense_mlp = mlp
             mlp = mlp * self.num_experts + d * self.num_experts  # + router
             if self.shared_expert_size:
                 mlp += 3 * d * self.shared_expert_size \
                     + (d if self.shared_expert_gate else 0)
+            if self.moe_residual:
+                mlp += dense_mlp + 2 * d + 2   # dense MLP + coefficient
         per_layer = attn + mlp + 2 * d
         emb = v * d + (self.max_seq_len * d if self.pos_emb == "learned"
                        else 0) + self.type_vocab_size * d
@@ -534,7 +543,18 @@ def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
     """
     def ffn(src):
         if cfg.num_experts and moe_fn is not None:
-            return moe_fn(cfg, p["moe"], src)
+            out, aux = moe_fn(cfg, p["moe"], src)
+            if "residual" in p["moe"]:
+                # Residual-MoE (reference moe/layer.py use_residual):
+                # learned convex mix of the routed output and a dense MLP
+                res = _mlp(cfg, p["moe"]["residual"], src)
+                coef = jax.nn.softmax(
+                    jnp.einsum("...d,dc->...c", src.astype(jnp.float32),
+                               p["moe"]["coef"].astype(jnp.float32))
+                    + p["moe"]["coef_b"].astype(jnp.float32),
+                    axis=-1).astype(src.dtype)
+                out = out * coef[..., 0:1] + res * coef[..., 1:2]
+            return out, aux
         return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32)
 
     if not cfg.prenorm:
@@ -562,7 +582,7 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
     h = cfg.ffn_size
     kd = cfg.kv_heads * cfg.head_dim
     qd = cfg.q_dim
-    keys = jax.random.split(rng, 16)
+    keys = jax.random.split(rng, 20)
 
     def w(key, shape, std=cfg.init_std):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
@@ -604,6 +624,28 @@ def init_params(cfg: DecoderConfig, rng: jax.Array,
             if cfg.shared_expert_gate:
                 shared["gate"] = w(keys[15], (L, d, 1))
             layers["moe"]["shared"] = shared
+        if cfg.moe_residual:
+            # Residual-MoE dense branch + 2-way mixing coefficient
+            # (reference moe/layer.py: self.mlp + self.coefficient)
+            if cfg.is_glu:
+                residual = {
+                    "wg": w(keys[16], (L, d, h)),
+                    "wi": w(keys[17], (L, d, h)),
+                    "wo": w(keys[18], (L, h, d),
+                            std=cfg.init_std / math.sqrt(2 * L)),
+                }
+            else:
+                residual = {
+                    "wi": w(keys[17], (L, d, h)),
+                    "wo": w(keys[18], (L, h, d),
+                            std=cfg.init_std / math.sqrt(2 * L)),
+                }
+                if cfg.use_bias:
+                    residual.update(bi=jnp.zeros((L, h), dtype),
+                                    bo=jnp.zeros((L, d), dtype))
+            layers["moe"]["residual"] = residual
+            layers["moe"]["coef"] = w(keys[19], (L, d, 2))
+            layers["moe"]["coef_b"] = jnp.zeros((L, 2), dtype)
     else:
         if cfg.is_glu:
             layers["mlp"] = {
@@ -1076,6 +1118,20 @@ def partition_specs(cfg: DecoderConfig, zero_stage: int = 0,
             if cfg.shared_expert_gate:
                 shared["gate"] = spec(None, fsdp, None)
             layers["moe"]["shared"] = shared
+        if cfg.moe_residual:
+            # residual dense branch: sharded like a dense MLP,
+            # replicated over 'expert' (runs on every token)
+            residual = {
+                "wi": spec(None, fsdp, model),
+                "wo": spec(None, model, fsdp),
+            }
+            if cfg.is_glu:
+                residual["wg"] = spec(None, fsdp, model)
+            elif cfg.use_bias:
+                residual.update(bi=spec(None, model), bo=spec(None, None))
+            layers["moe"]["residual"] = residual
+            layers["moe"]["coef"] = spec(None, fsdp, None)
+            layers["moe"]["coef_b"] = spec(None, None)
     else:
         mlp = {
             "wi": spec(None, fsdp, model),

@@ -206,6 +206,14 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     """
     from deepspeed_tpu.runtime.engine import ModelSpec
 
+    if (ds_cfg.moe.use_residual and dec_cfg.num_experts
+            and not dec_cfg.moe_residual):
+        # Residual-MoE via the DeepSpeed config knob (reference
+        # moe/layer.py use_residual) — architecture flag, so it folds
+        # into the model config before init/loss/specs are built
+        import dataclasses
+        dec_cfg = dataclasses.replace(dec_cfg, moe_residual=True)
+
     attn_fn = select_attention(ds_cfg, dec_cfg)
     moe_fn = select_moe(dec_cfg, ds_cfg)
     remat = ds_cfg.activation_checkpointing.policy
