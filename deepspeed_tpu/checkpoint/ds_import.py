@@ -1,0 +1,177 @@
+"""Import checkpoints saved by the reference (DeepSpeed) into this framework.
+
+Migration path for users switching from the reference: their training runs
+left behind DeepSpeed checkpoint directories, and those weights should load
+here without a detour through torch.
+
+Two on-disk formats are supported (both documented in SURVEY.md §5
+"Checkpoint / resume"; format details verified against the reference's
+writer, runtime/engine.py:3197–3261 and checkpoint/ds_to_universal.py:469):
+
+1. **Engine checkpoints** — ``<dir>/<tag>/mp_rank_00_model_states.pt``
+   written by ``engine.save_checkpoint``. The ``module`` entry is the
+   wrapped model's own ``state_dict()``; for HF models that means HF tensor
+   names, so the mapping into our pytree is exactly the HF-interop mapping
+   (`models/hf_loader.params_from_state`). The optional ``latest`` file at
+   the directory root names the tag.
+2. **Universal checkpoints (UCP)** — ``<dir>/<tag>/zero/<param_name>/fp32.pt``
+   per-parameter fp32 fragments produced by ``ds_to_universal.py``. Param
+   names are again module state-dict names, so the same mapping applies.
+
+Scope, by design:
+- Model-parallel (``mp_rank_01+``) shards are rejected with instructions to
+  consolidate first (the reference's own migration guidance); TP resharding
+  happens on OUR side via `module_inject/auto_tp.py` partition specs after
+  the full-shape weights are loaded — the AutoTP analogue shards pytrees,
+  not files.
+- ZeRO optimizer shards (``zero_pp_rank_*``/``bf16_zero_*``) hold flat
+  1-D partitions whose layout is private to the reference's optimizer; the
+  reference itself converts them via ``ds_to_universal`` — import that
+  output (format 2) instead. Optimizer state is rebuilt fresh here (the
+  moments live in a different, sharding-aware layout).
+
+Requires torch (CPU) to deserialize ``.pt`` files; gated at call time.
+"""
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import DecoderConfig
+from deepspeed_tpu.models.hf_loader import config_from_hf, params_from_state
+from deepspeed_tpu.utils.logging import logger
+
+Params = Any
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as exc:                       # pragma: no cover
+        raise RuntimeError(
+            "importing DeepSpeed .pt checkpoints requires torch "
+            "(CPU build is enough)") from exc
+    return torch
+
+
+def resolve_tag(ckpt_dir: str, tag: Optional[str] = None) -> str:
+    """Tag resolution mirroring the reference's ``latest`` convention."""
+    if tag is not None:
+        return tag
+    latest = os.path.join(ckpt_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as fh:
+            return fh.read().strip()
+    # single-subdir checkpoint dirs are unambiguous
+    subs = [d for d in sorted(os.listdir(ckpt_dir))
+            if os.path.isdir(os.path.join(ckpt_dir, d))]
+    if len(subs) == 1:
+        return subs[0]
+    raise ValueError(
+        f"cannot resolve checkpoint tag in {ckpt_dir}: no 'latest' file "
+        f"and {len(subs)} candidate subdirectories {subs}")
+
+
+def _strip_prefixes(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip wrapper prefixes ('module.', DDP-style) off state-dict keys."""
+    for prefix in ("module.", "model.module."):
+        if all(k.startswith(prefix) for k in sd):
+            sd = {k[len(prefix):]: v for k, v in sd.items()}
+    return sd
+
+
+def _state_reader(sd: Dict[str, Any]):
+    """(get, names) view over a torch state dict, matching _reader()."""
+    def get(name: str) -> np.ndarray:
+        t = sd[name]
+        if hasattr(t, "detach"):
+            t = t.detach().to("cpu").float().numpy()
+        return np.asarray(t)
+    return get, set(sd.keys())
+
+
+def load_ds_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],
+                       tag: Optional[str] = None, dtype=np.float32
+                       ) -> Tuple[DecoderConfig, Params]:
+    """Load a reference engine checkpoint into (DecoderConfig, params).
+
+    ``hf_config`` is the HF ``config.json`` dict of the wrapped model (the
+    reference does not checkpoint the model config — users keep it next to
+    the weights; same requirement here).
+    """
+    torch = _torch()
+    tag = resolve_tag(ckpt_dir, tag)
+    path = os.path.join(ckpt_dir, tag, "mp_rank_00_model_states.pt")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no model states at {path}")
+    other = os.path.join(ckpt_dir, tag, "mp_rank_01_model_states.pt")
+    if os.path.exists(other):
+        raise ValueError(
+            f"{ckpt_dir} is a model-parallel checkpoint ({other} "
+            "exists). Consolidate it first (reference: "
+            "ds_to_universal.py merges TP slices), then import the "
+            "universal checkpoint via load_universal_checkpoint().")
+    blob = torch.load(path, map_location="cpu", weights_only=False)
+    sd = blob.get("module", blob)
+    if not isinstance(sd, dict):                     # pragma: no cover
+        raise ValueError(f"unexpected model-states payload in {path}")
+    sd = _strip_prefixes(sd)
+    # ZeRO-3 model states saved without gather_16bit_weights hold 0-size
+    # placeholders (params live in the zero_pp_rank_* optimizer shards) —
+    # fail fast instead of stacking empty arrays into a garbage pytree
+    if any(getattr(t, "numel", lambda: 1)() == 0 for t in sd.values()):
+        raise ValueError(
+            f"{path} holds ZeRO-3 placeholder (0-size) tensors — the "
+            "weights live in the zero_pp_rank_* shards. Re-save with "
+            "stage3_gather_16bit_weights_on_model_save, or convert with "
+            "the reference's ds_to_universal.py / zero_to_fp32.py and "
+            "import via load_universal_checkpoint().")
+    cfg = config_from_hf(hf_config)
+    get, names = _state_reader(sd)
+    params = params_from_state(cfg, hf_config, get, names, dtype)
+    logger.info(f"imported DeepSpeed checkpoint {ckpt_dir}@{tag}: "
+                f"{cfg.num_params() / 1e6:.1f}M params")
+    return cfg, params
+
+
+def load_universal_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],
+                              tag: Optional[str] = None, dtype=np.float32
+                              ) -> Tuple[DecoderConfig, Params]:
+    """Load a reference *universal* checkpoint (ds_to_universal output).
+
+    Layout: ``<dir>/<tag>/zero/<param_name>/fp32.pt`` holds the merged
+    full-shape fp32 weight per parameter (reference
+    checkpoint/ds_to_universal.py: `merge_tp_slices`:232 writes one file
+    per param). Optimizer-state fragments (``exp_avg.pt`` …) are ignored —
+    moments are rebuilt in this framework's sharding-aware layout.
+    """
+    torch = _torch()
+    tag = resolve_tag(ckpt_dir, tag)
+    zero_dir = os.path.join(ckpt_dir, tag, "zero")
+    if not os.path.isdir(zero_dir):
+        raise FileNotFoundError(f"no universal-checkpoint dir at {zero_dir}")
+
+    def get(name: str) -> np.ndarray:
+        # no caching: each param is read exactly once by params_from_state,
+        # and holding fp32 copies would double peak host RAM at 70B scale
+        t = torch.load(os.path.join(zero_dir, name, "fp32.pt"),
+                       map_location="cpu", weights_only=False)
+        if isinstance(t, dict):                      # {'param': tensor} form
+            t = t.get("param", t)
+        return t.detach().float().numpy()
+
+    names = {d for d in os.listdir(zero_dir)
+             if os.path.exists(os.path.join(zero_dir, d, "fp32.pt"))}
+    # param dirs may carry the 'module.' prefix; normalize both views
+    if names and all(n.startswith("module.") for n in names):
+        raw_get = get
+
+        def get(name):                               # noqa: F811
+            return raw_get("module." + name)
+        names = {n[len("module."):] for n in names}
+    cfg = config_from_hf(hf_config)
+    params = params_from_state(cfg, hf_config, get, names, dtype)
+    logger.info(f"imported universal checkpoint {ckpt_dir}@{tag}: "
+                f"{cfg.num_params() / 1e6:.1f}M params")
+    return cfg, params

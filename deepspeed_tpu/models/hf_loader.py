@@ -711,34 +711,51 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
         hf_cfg = json.load(fh)
     cfg = config_from_hf(hf_cfg)
     get, names = _reader(model_dir)
+    params = params_from_state(cfg, hf_cfg, get, names, dtype)
+    logger.info(f"loaded HF checkpoint from {model_dir}: "
+                f"{cfg.num_params() / 1e6:.1f}M params, "
+                f"{hf_cfg.get('model_type')}")
+    return cfg, params
+
+
+def params_from_state(cfg: DecoderConfig, hf_cfg: Dict[str, Any], get, names,
+                      dtype=np.float32) -> Params:
+    """Map HF-convention tensor names → params pytree, source-agnostic.
+
+    ``get(name) -> np.ndarray`` and ``names`` may come from safetensors
+    shards (`load_hf_checkpoint`) or from a torch state dict (the
+    DeepSpeed-checkpoint importer, `checkpoint/ds_import.py`) — the name
+    conventions are identical because the reference engine checkpoints the
+    wrapped HF module's own state_dict (reference runtime/engine.py:3621).
+    """
     L = cfg.num_layers
     mt = hf_cfg.get("model_type")
     if mt == "bert":
-        return cfg, _load_bert(cfg, get, names, dtype)
+        return _load_bert(cfg, get, names, dtype)
     if mt == "distilbert":
-        return cfg, _load_distilbert(cfg, get, names, dtype)
+        return _load_distilbert(cfg, get, names, dtype)
     if mt == "gpt_neox":
-        return cfg, _load_neox(cfg, get, dtype)
+        return _load_neox(cfg, get, dtype)
     if mt == "gpt_neo":
-        return cfg, _load_gptneo(cfg, get, names, dtype)
+        return _load_gptneo(cfg, get, names, dtype)
     if mt == "qwen":
-        return cfg, _load_qwen(cfg, get, names, dtype)
+        return _load_qwen(cfg, get, names, dtype)
     if mt == "gpt2":
-        return cfg, _load_gpt2(cfg, get, names, dtype)
+        return _load_gpt2(cfg, get, names, dtype)
     if mt == "gpt_bigcode":
-        return cfg, _load_bigcode(cfg, get, names, dtype)
+        return _load_bigcode(cfg, get, names, dtype)
     if mt == "opt":
-        return cfg, _load_opt(cfg, get, names, dtype)
+        return _load_opt(cfg, get, names, dtype)
     if mt == "bloom":
-        return cfg, _load_bloom(cfg, get, names, dtype)
+        return _load_bloom(cfg, get, names, dtype)
     if mt == "falcon":
-        return cfg, _load_falcon(cfg, hf_cfg, get, names, dtype)
+        return _load_falcon(cfg, hf_cfg, get, names, dtype)
     if mt == "phi":
-        return cfg, _load_phi(cfg, get, dtype)
+        return _load_phi(cfg, get, dtype)
     if mt == "phi3":
-        return cfg, _load_phi3(cfg, get, names, dtype)
+        return _load_phi3(cfg, get, names, dtype)
     if mt == "gptj":
-        return cfg, _load_gptj(cfg, get, dtype)
+        return _load_gptj(cfg, get, dtype)
 
     def T(name):
         return np.ascontiguousarray(get(name).astype(dtype).T)
@@ -819,10 +836,7 @@ def load_hf_checkpoint(model_dir: str, dtype=np.float32
             ln["scale"] = ln["scale"] + 1.0
     if not cfg.tie_embeddings:
         params["lm_head"] = T("lm_head.weight")
-    logger.info(f"loaded HF checkpoint from {model_dir}: "
-                f"{cfg.num_params() / 1e6:.1f}M params, "
-                f"{hf_cfg.get('model_type')}")
-    return cfg, params
+    return params
 
 
 def _load_neox(cfg: DecoderConfig, get, dtype) -> Params:

@@ -1,0 +1,129 @@
+"""DeepSpeed-checkpoint migration tests (checkpoint/ds_import.py).
+
+Simulates the reference's on-disk checkpoint layouts (engine
+mp_rank_00_model_states.pt per runtime/engine.py:3197–3261; universal
+zero/<param>/fp32.pt per checkpoint/ds_to_universal.py) and imports them,
+asserting logits parity against the HF source model.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM
+
+from deepspeed_tpu.checkpoint.ds_import import (load_ds_checkpoint,
+                                                load_universal_checkpoint,
+                                                resolve_tag)
+from deepspeed_tpu.models import transformer
+
+
+def _tiny_llama():
+    cfg = LlamaConfig(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, vocab_size=256,
+                      max_position_embeddings=128, rope_theta=10000.0,
+                      rms_norm_eps=1e-6, tie_word_embeddings=False,
+                      attention_bias=False)
+    torch.manual_seed(7)
+    return LlamaForCausalLM(cfg).eval()
+
+
+def _write_engine_ckpt(model, root, tag="global_step10", prefix=""):
+    d = root / tag
+    d.mkdir(parents=True)
+    sd = {prefix + k: v for k, v in model.state_dict().items()}
+    torch.save({"module": sd, "global_steps": 10},
+               str(d / "mp_rank_00_model_states.pt"))
+    (root / "latest").write_text(tag)
+
+
+def _assert_logits_parity(hf_model, cfg, params):
+    tokens = np.arange(1, 17, dtype=np.int32)[None].repeat(2, 0)
+    ours = np.asarray(transformer.forward(
+        cfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(tokens.astype(np.int64))
+                          ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_checkpoint_import(tmp_path):
+    model = _tiny_llama()
+    _write_engine_ckpt(model, tmp_path)
+    cfg, params = load_ds_checkpoint(str(tmp_path),
+                                     model.config.to_dict())
+    assert cfg.num_heads == 4 and cfg.kv_heads == 2
+    _assert_logits_parity(model, cfg, params)
+
+
+def test_engine_checkpoint_import_module_prefix(tmp_path):
+    """Some reference paths checkpoint with a 'module.' wrapper prefix."""
+    model = _tiny_llama()
+    _write_engine_ckpt(model, tmp_path, prefix="module.")
+    cfg, params = load_ds_checkpoint(str(tmp_path),
+                                     model.config.to_dict())
+    _assert_logits_parity(model, cfg, params)
+
+
+def test_tag_resolution(tmp_path):
+    model = _tiny_llama()
+    _write_engine_ckpt(model, tmp_path, tag="epoch3")
+    assert resolve_tag(str(tmp_path)) == "epoch3"
+    os.remove(tmp_path / "latest")                  # single subdir fallback
+    assert resolve_tag(str(tmp_path)) == "epoch3"
+    assert resolve_tag(str(tmp_path), tag="explicit") == "explicit"
+
+
+def test_mp_rank_shards_rejected(tmp_path):
+    model = _tiny_llama()
+    _write_engine_ckpt(model, tmp_path)
+    torch.save({}, str(tmp_path / "global_step10" /
+                       "mp_rank_01_model_states.pt"))
+    with pytest.raises(ValueError, match="model-parallel"):
+        load_ds_checkpoint(str(tmp_path), model.config.to_dict())
+
+
+def test_zero3_placeholder_states_rejected(tmp_path):
+    """ZeRO-3 saves 0-size placeholders unless gather_16bit is on."""
+    model = _tiny_llama()
+    d = tmp_path / "global_step10"
+    d.mkdir(parents=True)
+    sd = {k: torch.empty(0) for k in model.state_dict()}
+    torch.save({"module": sd}, str(d / "mp_rank_00_model_states.pt"))
+    (tmp_path / "latest").write_text("global_step10")
+    with pytest.raises(ValueError, match="ZeRO-3 placeholder"):
+        load_ds_checkpoint(str(tmp_path), model.config.to_dict())
+
+
+def test_universal_checkpoint_import(tmp_path):
+    model = _tiny_llama()
+    tag = "global_step10"
+    zero = tmp_path / tag / "zero"
+    for name, tensor in model.state_dict().items():
+        pdir = zero / name
+        pdir.mkdir(parents=True)
+        torch.save(tensor.float(), str(pdir / "fp32.pt"))
+        # optimizer fragments present but ignored
+        torch.save(torch.zeros_like(tensor, dtype=torch.float32),
+                   str(pdir / "exp_avg.pt"))
+    (tmp_path / "latest").write_text(tag)
+    cfg, params = load_universal_checkpoint(str(tmp_path),
+                                            model.config.to_dict())
+    _assert_logits_parity(model, cfg, params)
+
+
+def test_universal_checkpoint_module_prefix(tmp_path):
+    model = _tiny_llama()
+    tag = "step5"
+    zero = tmp_path / tag / "zero"
+    for name, tensor in model.state_dict().items():
+        pdir = zero / ("module." + name)
+        pdir.mkdir(parents=True)
+        torch.save(tensor.float(), str(pdir / "fp32.pt"))
+    cfg, params = load_universal_checkpoint(str(tmp_path),
+                                            model.config.to_dict(), tag=tag)
+    _assert_logits_parity(model, cfg, params)
