@@ -59,10 +59,10 @@ def resolve_tag(ckpt_dir: str, tag: Optional[str] = None) -> str:
     """Tag resolution mirroring the reference's ``latest`` convention."""
     if tag is not None:
         return tag
-    latest = os.path.join(ckpt_dir, "latest")
-    if os.path.exists(latest):
-        with open(latest) as fh:
-            return fh.read().strip()
+    from deepspeed_tpu.checkpoint.store import latest_tag
+    latest = latest_tag(ckpt_dir)
+    if latest is not None:
+        return latest
     # single-subdir checkpoint dirs are unambiguous
     subs = [d for d in sorted(os.listdir(ckpt_dir))
             if os.path.isdir(os.path.join(ckpt_dir, d))]
