@@ -75,7 +75,10 @@ class BF16Config(TPUConfigModel):
 class ActivationCheckpointingConfig(TPUConfigModel):
     """Reference: activation_checkpointing block (runtime/activation_checkpointing).
     On TPU this maps to ``jax.checkpoint`` policies applied per transformer
-    block (remat), not manual partition/offload of activations."""
+    block (remat). ``cpu_checkpointing: true`` (the reference's host-memory
+    checkpointing knob) selects the ``offload_full`` policy: each layer's
+    residual-stream input is parked in pinned host DRAM via XLA's async
+    device→host copies and streamed back for backward."""
     partition_activations: bool = False
     cpu_checkpointing: bool = False
     contiguous_memory_optimization: bool = False
@@ -83,7 +86,9 @@ class ActivationCheckpointingConfig(TPUConfigModel):
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
     #: jax-native remat policy: 'none'|'full'|'save_attn_out'|'dots_saveable'|
-    #: 'nothing_saveable'|'dots_with_no_batch_dims_saveable'
+    #: 'nothing_saveable'|'dots_with_no_batch_dims_saveable', or host-offload
+    #: variants 'offload_attn_out'|'offload_attn_qkv'|'offload_full'|
+    #: 'offload_save_attn_out'
     policy: str = "none"
 
 

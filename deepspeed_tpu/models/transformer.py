@@ -421,6 +421,39 @@ def resolve_remat_policy(name: Optional[str]):
         "save_attn_qkv":
             jax.checkpoint_policies.save_only_these_names("attn_out",
                                                           "qkv"),
+        # Host-DRAM activation offload — the reference's cpu_checkpointing
+        # (runtime/activation_checkpointing/checkpointing.py partition/
+        # cpu_checkpoint knobs). XLA emits async copy-start/copy-done pairs
+        # to pinned host memory, overlapped with layer compute; backward
+        # streams the tensors back. 'offload_attn_out' keeps the
+        # save_attn_out recompute profile but parks attention outputs in
+        # host DRAM instead of HBM; 'offload_full' offloads each layer's
+        # residual-stream input and recomputes the whole block from it
+        # (max HBM savings — the cpu_checkpointing analogue proper).
+        "offload_attn_out":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["attn_out"],
+                offload_src="device", offload_dst="pinned_host"),
+        "offload_attn_qkv":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["attn_out", "qkv"],
+                offload_src="device", offload_dst="pinned_host"),
+        "offload_full":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["block_in"],
+                offload_src="device", offload_dst="pinned_host"),
+        # block_in to host + attn_out kept in HBM: backward skips the
+        # flash-attention recompute (the expensive part of 'full') while
+        # the carry chain stops occupying HBM — the long-context sweet
+        # spot when save_attn_out alone no longer fits
+        "offload_save_attn_out":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=["attn_out"],
+                names_which_can_be_offloaded=["block_in"],
+                offload_src="device", offload_dst="pinned_host"),
     }
     if name is not None and name not in policies:
         raise ValueError(f"unknown remat policy '{name}'; "
@@ -732,12 +765,14 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     if cfg.layer_window_pattern:
         def body(carry, xs):
             layer_params, w = xs
+            carry = checkpoint_name(carry, "block_in")
             out, aux = block(layer_params, carry, sin, cos, layer_window=w)
             return out, aux
         scan_xs = (params["layers"],
                    layer_windows(cfg))
     else:
         def body(carry, layer_params):
+            carry = checkpoint_name(carry, "block_in")
             out, aux = block(layer_params, carry, sin, cos)
             return out, aux
         scan_xs = params["layers"]

@@ -20,6 +20,7 @@ from deepspeed_tpu.models import transformer
 from deepspeed_tpu.models.transformer import (DecoderConfig,
                                               cross_entropy_loss,
                                               dot_product_attention)
+from deepspeed_tpu.utils.logging import logger
 
 
 #: pluggable attention implementations (the analogue of the reference's
@@ -217,6 +218,16 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
     attn_fn = select_attention(ds_cfg, dec_cfg)
     moe_fn = select_moe(dec_cfg, ds_cfg)
     remat = ds_cfg.activation_checkpointing.policy
+    if ds_cfg.activation_checkpointing.cpu_checkpointing and \
+            not remat.startswith("offload"):
+        # reference cpu_checkpointing knob: checkpointed activations live
+        # in host memory — map to the host-offload analogue of the chosen
+        # recompute profile (models/transformer.resolve_remat_policy)
+        upgraded = {"save_attn_out": "offload_save_attn_out"}.get(
+            remat, "offload_full")
+        logger.info(f"cpu_checkpointing: remat policy "
+                    f"'{remat}' -> '{upgraded}' (host-DRAM activations)")
+        remat = upgraded
     ce_budget = None if ds_cfg.chunked_ce_budget_mb is None \
         else int(ds_cfg.chunked_ce_budget_mb) * 1024 * 1024
     # values validated by the config model (Literal)

@@ -32,6 +32,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import transformer
@@ -94,6 +95,7 @@ def _stage_forward(cfg: DecoderConfig, local_layers, x, sin, cos,
                     moe_fn=moe_fn)
 
     def body(carry, layer_params):
+        carry = checkpoint_name(carry, "block_in")
         out, aux = block(layer_params, carry, sin, cos)
         return out, aux
 

@@ -311,6 +311,37 @@ def test_save_attn_qkv_remat_policy(devices):
                                losses["save_attn_qkv"], rtol=1e-5)
 
 
+def test_host_offload_remat_policy(devices):
+    """offload_full (the reference's cpu_checkpointing: activations parked
+    in pinned host DRAM between forward and backward) must train with the
+    same loss trajectory as plain full remat — offload changes residency,
+    never math. Also: the cpu_checkpointing config flag selects it."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    cfg = llama3_config("tiny", max_seq_len=32, vocab_size=256)
+    batch = {"input_ids": np.asarray(np.random.default_rng(1).integers(
+        0, 256, size=(8, 32)), np.int32)}
+    losses = {}
+    for ac in ({"policy": "full"}, {"policy": "offload_full"},
+               {"policy": "full", "cpu_checkpointing": True}):
+        build_mesh(data=8)
+        engine, _, _, _ = ds.initialize(
+            model=cfg,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "activation_checkpointing": ac},
+            rng=jax.random.PRNGKey(0))
+        key = ac["policy"] + str(ac.get("cpu_checkpointing", False))
+        losses[key] = [float(engine.train_batch(iter([batch])))
+                       for _ in range(3)]
+    np.testing.assert_allclose(losses["fullFalse"],
+                               losses["offload_fullFalse"], rtol=1e-5)
+    np.testing.assert_allclose(losses["fullFalse"],
+                               losses["fullTrue"], rtol=1e-5)
+
+
 def test_ce_bf16_logits_close_to_fp32(devices):
     """ce_logits_dtype=bf16 must track the fp32 path closely (same data,
     same init): per-step losses within bf16 rounding of the logits."""

@@ -63,6 +63,25 @@ def test_pipeline_matches_dp(devices):
                                atol=3e-4)
 
 
+def test_pipeline_host_offload_remat_matches(devices):
+    """offload_full on the PP path (stage scan names its carry 'block_in')
+    must reproduce the plain-remat pipeline losses — the host round-trip
+    changes residency, never math."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    data = _batches(8, seed=3)
+    losses = {}
+    for policy in ("full", "offload_full"):
+        build_mesh(data=4, pipe=2)
+        cfg = _cfg(2, 2, 4)
+        cfg["activation_checkpointing"] = {"policy": policy}
+        eng, *_ = initialize(model=model, config=cfg,
+                             rng=jax.random.PRNGKey(7))
+        it = iter(data)
+        losses[policy] = [float(eng.train_batch(it)) for _ in range(2)]
+    np.testing.assert_allclose(losses["offload_full"], losses["full"],
+                               rtol=1e-5)
+
+
 def test_pipeline_forward_backward_raises(devices):
     model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
     build_mesh(data=4, pipe=2)
