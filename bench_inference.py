@@ -57,6 +57,27 @@ def main() -> None:
     dtype = "bfloat16" if on_tpu else "float32"
     params = None   # random weights; throughput doesn't depend on values
 
+    if args.quant:
+        # ONE host-side init shared by both engines, pre-quantized on the
+        # host (numpy init: single-core threefry for 8B params costs ~25
+        # min; values don't matter for throughput). Both engines accept
+        # pre-quantized trees (the bin/dstpu_quantize serving path), so
+        # full-precision weights never touch HBM — int4 llama-8B serves
+        # on one 16G chip.
+        from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+        shapes = jax.eval_shape(
+            lambda r: init_params(model, r), jax.random.PRNGKey(0))
+        host_rng = np.random.default_rng(0)
+
+        def np_leaf(s):
+            flat = host_rng.standard_normal(int(np.prod(s.shape)),
+                                            dtype=np.float32) * 0.02
+            return flat.reshape(s.shape).astype(s.dtype)
+
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            params = quantize_param_tree(jax.tree.map(np_leaf, shapes),
+                                         mode=args.quant)
+
     rng = np.random.default_rng(0)
     # long-tail prompt lengths: few long, many short (padding's worst case)
     lens = rng.integers(16, 512, size=args.n_prompts)
@@ -66,8 +87,9 @@ def main() -> None:
     new = args.new_tokens
 
     # ---- padded v1: one batch padded to the longest prompt
-    wq = args.quant
-    v1 = init_inference(model, {"dtype": dtype, "weight_quant": wq},
+    # (pre-quantized trees carry their own scales — weight_quant stays
+    # unset; the engines detect quantized leaves)
+    v1 = init_inference(model, {"dtype": dtype},
                         params=params, rng=jax.random.PRNGKey(0))
     width = int(max(lens))
     padded = np.zeros((args.n_prompts, width), np.int32)
@@ -80,12 +102,19 @@ def main() -> None:
                    for _ in range(3))
 
     # ---- ragged v2: continuous batching over the true lengths
+    # arena sized to the workload: the flat 512-block default costs
+    # nb*block*L*kvh*dh*4 bytes (17 GB at llama-8B dims — more than HBM);
+    # the measured workload needs ceil((prompt+new)/block) blocks/seq
+    block = 64
+    blocks_per_seq = -(-(seq_cap + new) // block)
+    num_blocks = max(128, args.n_prompts * blocks_per_seq + 16)
     v2 = RaggedInferenceEngineTPU(
-        model, {"dtype": dtype, "num_blocks": 512, "block_size": 64,
+        model, {"dtype": dtype, "num_blocks": num_blocks,
+                "block_size": block,
                 "max_seq_len": seq_cap, "prefill_chunk": 512,
-                "max_batch_tokens": 8192, "weight_quant": wq,
+                "max_batch_tokens": 8192,
                 "use_pallas": (False if args.no_pallas else None)},
-        params=None if args.quant else v1.params,
+        params=params if args.quant else v1.params,
         rng=jax.random.PRNGKey(0))
     v2.generate(prompts, max_new_tokens=new)             # compile real buckets
     t_ragged = min(_timed(lambda: v2.generate(prompts, max_new_tokens=new))

@@ -116,6 +116,25 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
             if jnp.issubdtype(x.dtype, jnp.floating) else x
 
     if params is None:
+        if config.weight_quant:
+            # init + quantize on HOST, ship only the quantized tree: a
+            # model can be servable quantized (int4 llama-8B ≈ 5 GB) yet
+            # far larger than HBM in bf16 (16 GB) — materializing full
+            # precision on device first would OOM before the memory win.
+            # The reference streams+quantizes checkpoints host-side the
+            # same way (module_inject load_checkpoint + module_quantize).
+            # NOTE: random init stays on jax PRNG for weight parity with
+            # the on-device path — slow for 8B-scale demos (single-core
+            # threefry); real large models load checkpoints (hf_loader)
+            # or pre-quantized bin/dstpu_quantize trees instead.
+            from deepspeed_tpu.ops.quantized_linear import \
+                quantize_param_tree
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                host = jax.tree.map(cast, init_params(model, rng))
+                host = quantize_param_tree(host, mode=config.weight_quant)
+            rep = NamedSharding(mesh, P())
+            return mesh, dtype, jax.tree.map(
+                lambda v: jax.device_put(v, rep), host), param_sh
         init = jax.jit(lambda r: jax.tree.map(cast, init_params(model, r)),
                        out_shardings=param_sh)
         params = init(rng)
@@ -143,26 +162,10 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
                 "params are already quantized (scale leaves present); "
                 "drop weight_quant from the config")
         rep = NamedSharding(mesh, P())
-
-        def put_q(d):
-            # dtype policy must NOT touch the quantization artifacts:
-            # fp8 weights are a floating dtype (casting them to bf16
-            # would silently undo the memory win) and _scale leaves
-            # must stay f32 (bf16 scales shift every channel by up to
-            # 2^-9 vs the startup-quantization path)
-            out = {}
-            for k, v in d.items():
-                if isinstance(v, dict):
-                    out[k] = put_q(v)
-                    continue
-                keep = (k.endswith("_scale") or k == "lm_head_q"
-                        or v.dtype == jnp.float8_e4m3fn
-                        or not jnp.issubdtype(v.dtype, jnp.floating))
-                out[k] = jax.device_put(v if keep else v.astype(dtype),
-                                        rep)
-            return out
-
-        return mesh, dtype, put_q(params), param_sh
+        from deepspeed_tpu.ops.quantized_linear import cast_quantized_tree
+        placed = jax.tree.map(lambda v: jax.device_put(v, rep),
+                              cast_quantized_tree(params, dtype))
+        return mesh, dtype, placed, param_sh
     else:
         params = jax.device_put(jax.tree.map(cast, params), param_sh)
     if config.weight_quant:

@@ -269,12 +269,42 @@ class RaggedInferenceEngineTPU:
         cast = lambda t: jax.tree.map(
             lambda x: x.astype(self.dtype)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
-        self.params = cast(params if params is not None
-                           else init_params(model, rng))
-        if config.weight_quant:
-            from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
-            self.params = quantize_param_tree(self.params,
-                                              mode=config.weight_quant)
+        from deepspeed_tpu.inference.engine import _is_quantized_tree
+        from deepspeed_tpu.ops.quantized_linear import (
+            cast_quantized_tree, quantize_param_tree)
+        # explicit accelerator target: plain jax.device_put(x) is an
+        # IDENTITY for already-placed arrays, so host-built trees would
+        # silently stay CPU-resident and stream per step
+        dev0 = jax.devices()[0]
+        if params is None and config.weight_quant:
+            # init + quantize on HOST, ship only the quantized tree (same
+            # rationale as the v1 engine: int4 llama-8B serves in ~5 GB
+            # but would OOM materialized bf16-first on a 16 GB chip).
+            # NOTE: random init is kept on jax PRNG for weight parity with
+            # the on-device path — slow for 8B-scale demos (single-core
+            # threefry); real large models load checkpoints (hf_loader)
+            # or pre-quantized trees instead.
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                host = quantize_param_tree(cast(init_params(model, rng)),
+                                           mode=config.weight_quant)
+            self.params = jax.tree.map(
+                lambda v: jax.device_put(v, dev0), host)
+        elif params is not None and _is_quantized_tree(params):
+            # pre-quantized (bin/dstpu_quantize / host-quantized) tree:
+            # dtype policy must not touch scales / fp8 / packed planes
+            if config.weight_quant:
+                raise ValueError(
+                    "params are already quantized (scale leaves present); "
+                    "drop weight_quant from the config")
+            self.params = jax.tree.map(
+                lambda v: jax.device_put(v, dev0),
+                cast_quantized_tree(params, self.dtype))
+        else:
+            self.params = cast(params if params is not None
+                               else init_params(model, rng))
+            if config.weight_quant:
+                self.params = quantize_param_tree(self.params,
+                                                  mode=config.weight_quant)
         self.arena = pa.init_arena(model.num_layers, model.kv_heads,
                                    config.num_blocks, config.block_size,
                                    model.head_dim, self.dtype)

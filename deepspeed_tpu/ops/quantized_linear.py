@@ -672,3 +672,23 @@ def quantize_param_tree(params, targets=("wq", "wk", "wv", "wo", "wg",
         out["lm_head_q"] = q
         out["lm_head_q" + SCALE_SUFFIX] = s
     return out
+
+
+def cast_quantized_tree(params, dtype):
+    """dtype-cast the float leaves of a (pre-)quantized tree WITHOUT
+    touching the quantization artifacts: ``_scale`` leaves must stay f32
+    (bf16 scales shift every channel by up to 2^-9), fp8 weights are a
+    floating dtype whose cast would silently undo the memory win, and
+    packed int planes are integers anyway."""
+    def rec(d):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = rec(v)
+                continue
+            keep = (k.endswith(SCALE_SUFFIX) or k == "lm_head_q"
+                    or v.dtype == jnp.float8_e4m3fn
+                    or not jnp.issubdtype(v.dtype, jnp.floating))
+            out[k] = v if keep else v.astype(dtype)
+        return out
+    return rec(params)

@@ -223,7 +223,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         # reference cpu_checkpointing knob: checkpointed activations live
         # in host memory — map to the host-offload analogue of the chosen
         # recompute profile (models/transformer.resolve_remat_policy)
-        upgraded = {"save_attn_out": "offload_save_attn_out"}.get(
+        upgraded = {"save_attn_out": "offload_save_attn_out",
+                    "save_attn_qkv": "offload_attn_qkv"}.get(
             remat, "offload_full")
         logger.info(f"cpu_checkpointing: remat policy "
                     f"'{remat}' -> '{upgraded}' (host-DRAM activations)")

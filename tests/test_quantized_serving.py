@@ -226,6 +226,37 @@ def test_quantized_ragged_engine_generates(devices):
         assert (np.asarray(o) < 256).all()
 
 
+def test_ragged_engine_serves_prequantized_tree(devices):
+    """A host-quantized tree handed to the ragged engine (the
+    bench/dstpu_quantize path: full precision never touches the device)
+    must decode token-for-token like in-engine quantization of the same
+    weights, and must reject a conflicting weight_quant config."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.ops.quantized_linear import quantize_param_tree
+    build_mesh(data=8)
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    ecfg = {"dtype": "float32", "num_blocks": 64, "block_size": 16,
+            "max_seq_len": 128}
+    full = init_params(cfg, jax.random.PRNGKey(3))
+    e_in = RaggedInferenceEngineTPU(cfg, {**ecfg, "weight_quant": "int4"},
+                                    params=full)
+    pre = quantize_param_tree(full, mode="int4")
+    e_pre = RaggedInferenceEngineTPU(cfg, ecfg, params=pre)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, size=(n,), dtype=np.int32)
+               for n in (9, 17, 5)]
+    a = e_in.generate(prompts, max_new_tokens=6, temperature=0.0)
+    b = e_pre.generate(prompts, max_new_tokens=6, temperature=0.0)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(ValueError, match="already quantized"):
+        RaggedInferenceEngineTPU(cfg, {**ecfg, "weight_quant": "int4"},
+                                 params=pre)
+
+
 @pytest.mark.parametrize("mode", ["int8", "fp8"])
 @pytest.mark.parametrize("tied", [True, False])
 def test_quantize_param_tree_rejects_double_apply(devices, mode, tied):
