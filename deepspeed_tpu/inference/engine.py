@@ -81,6 +81,47 @@ def _has_packed_leaves(params) -> bool:
                for v in jax.tree.leaves(params))
 
 
+def _divides(sh: NamedSharding, shape) -> bool:
+    """True when every dim of ``shape`` divides its mesh-axis product
+    under ``sh`` — uneven placement would raise at device_put, whereas
+    the qmatmul kernels handle non-divisible shapes by falling back to
+    the replicated path (qmatmul_tp / qmatmul_batched_ep guards)."""
+    for dim, names in zip(shape, sh.spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for n in names:
+            size *= sh.mesh.shape[n]
+        if dim % size:
+            return False
+    return True
+
+
+def _shard_like(qtree, sh_tree, mesh):
+    """Sharding tree for a quantized param tree: quantized weight leaves
+    keep their original partition spec (int8/fp8 leaves are elementwise
+    replacements, same shape); the tied-embedding logits copy
+    ``lm_head_q`` [D, V] shards on V over 'model' (qmatmul_tp's col
+    layout); per-channel ``_scale`` leaves replicate (tiny, and the
+    scale commutes with the shard reduction). Any leaf whose spec
+    doesn't divide its shape replicates — the kernels' non-divisible
+    fallback then runs exactly as before."""
+    rep = NamedSharding(mesh, P())
+    head_sh = NamedSharding(mesh, P(None, "model"))
+    out = {}
+    for k, v in qtree.items():
+        sub = sh_tree.get(k) if isinstance(sh_tree, dict) else None
+        if isinstance(v, dict):
+            out[k] = _shard_like(v, sub if isinstance(sub, dict) else {},
+                                 mesh)
+            continue
+        sh = sub if isinstance(sub, NamedSharding) else \
+            (head_sh if k == "lm_head_q" else rep)
+        out[k] = sh if _divides(sh, v.shape) else rep
+    return out
+
+
 def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
     """Shared serving-engine bring-up (v1 generator + encoder engine):
     mesh resolution, dtype policy, TP/EP weight-quant guards, GSPMD
@@ -133,23 +174,29 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
                 host = jax.tree.map(cast, init_params(model, rng))
                 host = quantize_param_tree(host, mode=config.weight_quant)
             rep = NamedSharding(mesh, P())
-            return mesh, dtype, jax.tree.map(
-                lambda v: jax.device_put(v, rep), host), param_sh
+            # int8/fp8 under TP/EP: place quantized leaves with their
+            # matching partition specs so per-chip weight HBM shrinks by
+            # tp× instead of replicating (packed int4/fp6 planes can't
+            # shard and are guarded to tp=ep=1 above, where rep == spec)
+            sh = jax.tree.map(lambda _: rep, host) \
+                if _has_packed_leaves(host) else \
+                _shard_like(host, param_sh, mesh)
+            return mesh, dtype, jax.device_put(host, sh), param_sh
         init = jax.jit(lambda r: jax.tree.map(cast, init_params(model, r)),
                        out_shardings=param_sh)
         params = init(rng)
     elif _is_quantized_tree(params):
-        # pre-quantized tree (bin/dstpu_quantize output): extra _scale /
-        # lm_head_q leaves don't match the partition-spec pytree, and
-        # quantized leaves only serve unsharded anyway (same restriction
-        # as weight_quant) — replicate onto the mesh leaf-wise
+        # pre-quantized tree (bin/dstpu_quantize output): int8/fp8
+        # weight leaves place with their original partition specs
+        # (_shard_like; scales and non-divisible leaves replicate);
+        # packed int4/fp6 planes cannot shard and replicate wholesale
         if tp and _has_packed_leaves(params):
             raise ValueError(
                 "pre-quantized packed (int4/fp6) params require "
                 "tp_size=1 / a mesh with model axis 1: the packed "
                 "nibble/6-bit planes cannot be sharded. Pre-quantized "
-                "int8/fp8 trees DO serve under TP (qmatmul_tp reshards "
-                "the replicated leaves per matmul)")
+                "int8/fp8 trees DO serve under TP (their leaves place "
+                "TP-sharded and route through qmatmul_tp)")
         if model.num_experts and mesh.shape["expert"] > 1 and \
                 _has_packed_leaves(params):
             raise ValueError(
@@ -163,9 +210,11 @@ def setup_engine_params(model: DecoderConfig, config, mesh, params, rng):
                 "drop weight_quant from the config")
         rep = NamedSharding(mesh, P())
         from deepspeed_tpu.ops.quantized_linear import cast_quantized_tree
-        placed = jax.tree.map(lambda v: jax.device_put(v, rep),
-                              cast_quantized_tree(params, dtype))
-        return mesh, dtype, placed, param_sh
+        host = cast_quantized_tree(params, dtype)
+        sh = jax.tree.map(lambda _: rep, host) \
+            if _has_packed_leaves(host) else \
+            _shard_like(host, param_sh, mesh)
+        return mesh, dtype, jax.device_put(host, sh), param_sh
     else:
         params = jax.device_put(jax.tree.map(cast, params), param_sh)
     if config.weight_quant:
