@@ -98,13 +98,29 @@ def _divides(sh: NamedSharding, shape) -> bool:
     return True
 
 
-def _shard_like(qtree, sh_tree, mesh):
+def _strip_axis(spec: P, axis: str) -> P:
+    out = []
+    for e in spec:
+        if e == axis:
+            out.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a != axis)
+            out.append(kept if kept else None)
+        else:
+            out.append(e)
+    return P(*out)
+
+
+def _shard_like(qtree, sh_tree, mesh, in_moe=False):
     """Sharding tree for a quantized param tree: quantized weight leaves
     keep their original partition spec (int8/fp8 leaves are elementwise
     replacements, same shape); the tied-embedding logits copy
     ``lm_head_q`` [D, V] shards on V over 'model' (qmatmul_tp's col
     layout); per-channel ``_scale`` leaves replicate (tiny, and the
-    scale commutes with the shard reduction). Any leaf whose spec
+    scale commutes with the shard reduction). MoE expert leaves keep
+    only their 'expert' sharding — the grouped quantized kernel
+    (qmatmul_batched_ep) has no TP path, so a 'model'-sharded expert
+    weight would be allgathered at every use. Any leaf whose spec
     doesn't divide its shape replicates — the kernels' non-divisible
     fallback then runs exactly as before."""
     rep = NamedSharding(mesh, P())
@@ -114,10 +130,12 @@ def _shard_like(qtree, sh_tree, mesh):
         sub = sh_tree.get(k) if isinstance(sh_tree, dict) else None
         if isinstance(v, dict):
             out[k] = _shard_like(v, sub if isinstance(sub, dict) else {},
-                                 mesh)
+                                 mesh, in_moe=in_moe or k == "moe")
             continue
         sh = sub if isinstance(sub, NamedSharding) else \
             (head_sh if k == "lm_head_q" else rep)
+        if in_moe and isinstance(sh, NamedSharding):
+            sh = NamedSharding(mesh, _strip_axis(sh.spec, "model"))
         out[k] = sh if _divides(sh, v.shape) else rep
     return out
 

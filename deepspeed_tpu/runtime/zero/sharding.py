@@ -86,6 +86,23 @@ def shard_over_dp(shape: Tuple[int, ...], spec: Optional[P], mesh: Mesh,
     dp = _axes_size(mesh, free_axes)
     if dp == 1:
         return P(*entries)
+    # FIRST: extend a dim already sharded by DP-family axes (hpZ/MiCS
+    # param shards over 'data_inner' only). Appending the free axes
+    # nests the finer grad/state chunk inside the coarser param shard,
+    # so param↔grad↔state reshards stay single-dim slices/allgathers.
+    # Sharding a SECOND dim instead (the fallback below) gives the
+    # backward matmuls a mixed two-dim target sharding that the SPMD
+    # partitioner can only reach by involuntary full rematerialization
+    # (replicate-then-slice of every grad scatter — the MULTICHIP_r02
+    # dryrun warnings on the mics/multislice paths).
+    for i, e in enumerate(entries):
+        if e is None:
+            continue
+        cur = tuple(e) if isinstance(e, (tuple, list)) else (e,)
+        if all(a in dp_axes for a in cur) and \
+                shape[i] % (_axes_size(mesh, cur) * dp) == 0:
+            entries[i] = cur + free_axes
+            return P(*entries)
     # candidate dims: unsharded, divisible by dp — largest first
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for i in order:
