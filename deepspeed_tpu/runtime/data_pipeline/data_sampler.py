@@ -27,7 +27,8 @@ class DeepSpeedDataSampler:
     def __init__(self, metric_values: Sequence[float],
                  batch_size: int,
                  curriculum: Optional[CurriculumScheduler] = None,
-                 dp_rank: int = 0, dp_world: int = 1, seed: int = 0):
+                 dp_rank: int = 0, dp_world: int = 1, seed: int = 0,
+                 micro_steps_per_global_step: int = 1):
         self.metric = np.asarray(metric_values, np.float64)
         self.order = np.argsort(self.metric, kind="stable")
         self.sorted_metric = self.metric[self.order]
@@ -40,13 +41,20 @@ class DeepSpeedDataSampler:
         self.seed = seed
         self.consumed_samples = 0
         self.step = 0
+        # with gradient accumulation the sampler yields gas index batches
+        # per optimizer step; the curriculum schedule is expressed in
+        # GLOBAL steps (reference CurriculumScheduler semantics), so
+        # difficulty is keyed to step // gas
+        self.micro_steps_per_global_step = max(
+            1, int(micro_steps_per_global_step))
 
     def _pool(self) -> np.ndarray:
         """Indices allowed at the current difficulty (sorted pool
         prefix)."""
         if self.curriculum is None:
             return self.order
-        limit = self.curriculum.get_difficulty(self.step)
+        limit = self.curriculum.get_difficulty(
+            self.step // self.micro_steps_per_global_step)
         hi = np.searchsorted(self.sorted_metric, limit, side="right")
         hi = max(hi, min(self.batch_size, len(self.order)))
         return self.order[:hi]

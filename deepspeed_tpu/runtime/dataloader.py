@@ -2,15 +2,21 @@
 
 Reference: runtime/dataloader.py (DeepSpeedDataLoader with
 DistributedSampler) + engine.deepspeed_io:2035. TPU-native difference: one
-process drives all local devices, so the loader yields **global**
-microbatches of size micro_batch × dp_world; the engine shards the batch
-dim over the DP mesh axes on device_put. Single-process scope for now:
-multi-host loading (per-process slices assembled via
-``jax.make_array_from_process_local_data``) is a planned follow-on and is
-NOT yet implemented here.
+process drives all local devices, so rank sharding happens at **process**
+granularity, not device granularity. Each process loads only its
+``global_batch / process_count`` slice of every global microbatch (the
+analogue of the reference's DistributedSampler rank sharding); the engine
+assembles the jax global array from the per-process slices via
+``jax.make_array_from_process_local_data``. On one process the slice is
+the whole batch and placement degenerates to a plain ``device_put``.
+
+Curriculum / data-efficiency sampling (reference
+``data_sampling/data_sampler.py:36`` + engine ``deepspeed_io``:2035) plugs
+in as a ``data_sampler``: when given, the loader draws per-step index
+batches from the sampler (difficulty-gated by the CurriculumScheduler)
+instead of epoch-shuffled sequential order.
 """
 
-import math
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -18,23 +24,40 @@ import numpy as np
 
 
 class DeepSpeedTPUDataLoader:
-    """Iterate a map-style dataset (indexable, len()) as global microbatches.
+    """Iterate a map-style dataset (indexable, len()) as per-process slices
+    of global microbatches.
 
     Items may be dicts of arrays or tuples (input_ids, labels). A
-    ``collate_fn`` may override batching.
+    ``collate_fn`` may override batching. ``process_index`` /
+    ``process_count`` default to the jax runtime's; every process must
+    construct the loader with the same seed so the shuffled orders agree
+    and the slices partition each global batch.
     """
 
     def __init__(self, dataset, micro_batch_size: int, dp_world_size: int,
                  seed: int = 0, shuffle: bool = True, drop_last: bool = True,
-                 collate_fn: Optional[Callable] = None):
+                 collate_fn: Optional[Callable] = None,
+                 data_sampler=None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
         self.dataset = dataset
         self.micro_batch_size = micro_batch_size
         self.dp_world_size = dp_world_size
         self.global_batch = micro_batch_size * dp_world_size
+        self.process_index = (jax.process_index() if process_index is None
+                              else int(process_index))
+        self.process_count = (jax.process_count() if process_count is None
+                              else int(process_count))
+        if self.global_batch % self.process_count:
+            raise ValueError(
+                f"global microbatch {self.global_batch} not divisible by "
+                f"process_count {self.process_count}")
+        self.local_batch = self.global_batch // self.process_count
         self.seed = seed
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
+        self.data_sampler = data_sampler
         self.epoch = 0
         if len(dataset) < self.global_batch:
             raise ValueError(
@@ -50,7 +73,26 @@ class DeepSpeedTPUDataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
+    def _local_slice(self, idx: np.ndarray) -> np.ndarray:
+        """This process's contiguous slice of a global index batch. The
+        engine reassembles the global array from these slices, so slice i
+        must cover the batch rows process i's devices own — contiguous
+        process-major, matching mesh construction from jax.devices()."""
+        start = self.process_index * self.local_batch
+        return idx[start:start + self.local_batch]
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.data_sampler is not None:
+            return self._sampler_iter()
+        return self._epoch_iter()
+
+    def _sampler_iter(self) -> Iterator[Dict[str, np.ndarray]]:
+        # the sampler itself shards per process (dp_rank=process_index);
+        # it yields this process's index slice per step, forever
+        for idx in self.data_sampler:
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def _epoch_iter(self) -> Iterator[Dict[str, np.ndarray]]:
         order = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
@@ -65,6 +107,7 @@ class DeepSpeedTPUDataLoader:
                 # pad by wrapping (keeps static shapes for jit)
                 idx = np.concatenate(
                     [idx, order[:self.global_batch - len(idx)]])
+            idx = self._local_slice(idx)
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 
 

@@ -581,12 +581,13 @@ class DeepSpeedTPUEngine:
         """Fused whole-step path (reference PipelineEngine.train_batch:337 —
         here the non-pipeline fast path; pipeline engine overrides)."""
         gas = int(self.config.gradient_accumulation_steps)
+        own_data = data_iter is None
         it = data_iter if data_iter is not None else self._own_data_iterator()
         micros = [next(it) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
         if self.config.check_nan_inf:
             self._check_batch_consistency(micros)   # ALL microbatches
-        batch = self._place_stacked_batch(batch)
+        batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
         if self.offload_enabled:
@@ -624,6 +625,8 @@ class DeepSpeedTPUEngine:
             self.global_steps += 1
             self.micro_steps += gas
             self.global_samples += int(self.config.train_batch_size)
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
             self._last_metrics = metrics
             self.tput_timer.stop(sync=loss)
             self._write_monitor(metrics)
@@ -635,6 +638,8 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += int(self.config.train_batch_size)
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
             self.skipped_steps += 1
         self._last_metrics = metrics
@@ -651,9 +656,26 @@ class DeepSpeedTPUEngine:
         if jax.process_count() <= 1:
             return
         import hashlib
+        pc = jax.process_count()
+        global_b = int(self.config.train_micro_batch_size_per_gpu) \
+            * self.dp_world_size
         h = hashlib.sha256()
         for leaf in jax.tree.leaves(micros):
-            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+            leaf = np.asarray(leaf)
+            if leaf.ndim and leaf.shape[0] * pc == global_b:
+                # per-process local slices: contents legitimately differ;
+                # the invariant is structural (same shapes/dtypes) plus
+                # identical loader schedule, checked via seed/epoch below
+                h.update(repr((leaf.shape, str(leaf.dtype))).encode())
+            else:
+                h.update(np.ascontiguousarray(leaf).tobytes())
+        if self.training_dataloader is not None:
+            h.update(repr((self.training_dataloader.seed,
+                           self.training_dataloader.epoch)).encode())
+        if self.data_sampler is not None:
+            # sampler position must agree or the per-process slices come
+            # from different steps and assemble a garbage global batch
+            h.update(repr(self.data_sampler.state_dict()).encode())
         digest = np.frombuffer(h.digest()[:8], np.int64)
         from jax.experimental import multihost_utils
         all_digests = multihost_utils.process_allgather(digest)
@@ -768,12 +790,36 @@ class DeepSpeedTPUEngine:
 
     # -------------------------------------------------------------- batches
 
-    def _place_batch(self, batch: Batch) -> Batch:
+    def _put_global(self, x, sharding, batch_dim: int, local: bool):
+        """Assemble a global array on ``sharding``. Two multi-host modes
+        (reference DistributedSampler rank sharding vs replicated input):
+        when the batch came from the engine's own dataloader (``local``),
+        each leaf's batch dim is ``global/process_count`` — this process's
+        slice, assembled zero-copy via
+        ``jax.make_array_from_process_local_data``. User-supplied batches
+        are identical on every process and device_put scatters them (the
+        size check alone can't distinguish a slice from e.g. a broadcast
+        [1, ...] mask leaf, so ``local`` is decided by provenance)."""
+        x = jnp.asarray(x) if not isinstance(x, (np.ndarray, jax.Array)) \
+            else x
+        pc = jax.process_count()
+        if local and pc > 1 and np.ndim(x) > batch_dim:
+            global_b = int(self.config.train_micro_batch_size_per_gpu) \
+                * self.dp_world_size
+            if x.shape[batch_dim] * pc == global_b:
+                gshape = list(x.shape)
+                gshape[batch_dim] = global_b
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x), tuple(gshape))
+        return jax.device_put(jnp.asarray(x), sharding)
+
+    def _place_batch(self, batch: Batch, local: bool = False) -> Batch:
         sh = self._batch_sharding(batch)
         return jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+            lambda x, s: self._put_global(x, s, 0, local), batch, sh)
 
-    def _place_stacked_batch(self, batch: Batch) -> Batch:
+    def _place_stacked_batch(self, batch: Batch, local: bool = False
+                             ) -> Batch:
         """batch leaves: [gas, B, ...] — shard B (dim 1) over DP."""
         sp = self.mesh.shape["seq"] > 1
 
@@ -785,17 +831,56 @@ class DeepSpeedTPUEngine:
             return NamedSharding(self.mesh, P(*entries))
         sh = jax.tree.map(spec_for, batch)
         return jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+            lambda x, s: self._put_global(x, s, 1, local), batch, sh)
 
     def _build_dataloader(self, training_data):
+        self.curriculum_scheduler = None
+        self.data_sampler = None
         if training_data is None:
             return None
         from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+        micro = int(self.config.train_micro_batch_size_per_gpu)
+        de = self.config.data_efficiency
+        sampler = None
+        if de.enabled and (de.curriculum_learning.get("enabled")
+                           or de.data_sampling.get("enabled")):
+            gas = int(self.config.gradient_accumulation_steps)
+            # reference deepspeed_io:2035 builds DeepSpeedDataSampler when
+            # data-efficiency sampling/curriculum is on; difficulty metric
+            # comes from the analyzer output (here: config-provided values,
+            # a .npy path, or per-sample len() as the fallback metric)
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import CurriculumScheduler
+            from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+                DeepSpeedDataSampler)
+            if de.curriculum_learning.get("enabled"):
+                cl = {k: v for k, v in de.curriculum_learning.items()
+                      if k != "enabled"}
+                self.curriculum_scheduler = CurriculumScheduler(cl)
+            ds_cfg = de.data_sampling
+            metric = ds_cfg.get("metric_values")
+            if metric is None and ds_cfg.get("metric_path"):
+                metric = np.load(ds_cfg["metric_path"])
+            if metric is None:
+                metric = [len(training_data[i])
+                          if hasattr(training_data[i], "__len__") else 0
+                          for i in range(len(training_data))]
+            if len(metric) != len(training_data):
+                raise ValueError(
+                    f"data_sampling metric has {len(metric)} entries but "
+                    f"training_data has {len(training_data)} samples")
+            sampler = DeepSpeedDataSampler(
+                metric, batch_size=micro * self.dp_world_size,
+                curriculum=self.curriculum_scheduler,
+                dp_rank=jax.process_index(), dp_world=jax.process_count(),
+                seed=de.seed, micro_steps_per_global_step=gas)
+            self.data_sampler = sampler
         return DeepSpeedTPUDataLoader(
             training_data,
-            micro_batch_size=int(self.config.train_micro_batch_size_per_gpu),
+            micro_batch_size=micro,
             dp_world_size=self.dp_world_size,
-            seed=self.config.seed)
+            seed=self.config.seed,
+            data_sampler=sampler)
 
     # -------------------------------------------------------------- monitor
 
@@ -926,6 +1011,8 @@ class DeepSpeedTPUEngine:
             "optimizer": self.optimizer.hyperparams,
             "client_state": client_state or {},
             "offload": self.offload_enabled,
+            "data_sampler": (self.data_sampler.state_dict()
+                             if self.data_sampler is not None else None),
         }
         root = _save(save_dir, tag, state, meta, save_latest=save_latest,
                      async_save=async_save)
@@ -972,6 +1059,8 @@ class DeepSpeedTPUEngine:
         self.micro_steps = meta.get("micro_steps", 0)
         self.skipped_steps = meta.get("skipped_steps", 0)
         self.global_samples = meta.get("global_samples", 0)
+        if self.data_sampler is not None and meta.get("data_sampler"):
+            self.data_sampler.load_state_dict(meta["data_sampler"])
         return tag, meta.get("client_state", {})
 
 
