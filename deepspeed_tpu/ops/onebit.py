@@ -1,4 +1,4 @@
-"""1-bit Adam / 1-bit LAMB — communication-compressed optimizer family.
+"""1-bit Adam / 1-bit LAMB / 0/1 Adam — communication-compressed optimizers.
 
 Reference: ``runtime/fp16/onebit/adam.py:14`` (OnebitAdam), ``lamb.py:16``
 (OnebitLamb), ``zoadam.py`` (0/1 Adam), over the compressed backends
@@ -65,6 +65,10 @@ def validate_onebit(engine) -> None:
         raise ValueError("1-bit Adam does not compose with pipeline")
 
 
+def _is_zeroone(opt_type: str) -> bool:
+    return "zeroone" in opt_type.lower().replace("-", "").replace("_", "")
+
+
 def init_onebit_state(engine) -> None:
     """Replicated flat master/m/v + per-device error-feedback buffers."""
     mesh = engine.mesh
@@ -74,6 +78,7 @@ def init_onebit_state(engine) -> None:
     total = layout.total
     padded = padded_size(total, world)
     engine._onebit_padded = padded
+    zeroone = _is_zeroone(engine.config.optimizer.type)
 
     rep = NamedSharding(mesh, P())
     dp = NamedSharding(mesh, P("data"))
@@ -93,11 +98,27 @@ def init_onebit_state(engine) -> None:
         # by the adam variants too so the state treedef is uniform
         "coeff": jax.device_put(
             jnp.ones((len(layout.sizes),), jnp.float32), rep),
+        # 0/1 Adam extras (zoadam.py state): the momentum accumulator u
+        # (local updates applied between syncs), accumulated lr, and the
+        # adaptive variance/local-step interval policy scalars. The u
+        # buffer is param-sized so only 0/1 Adam allocates it.
+        "u": jax.device_put(
+            jnp.zeros((total if zeroone else 0,), jnp.float32), rep),
+        "lrs": jax.device_put(jnp.zeros((), jnp.float32), rep),
+        "var_interval": jax.device_put(jnp.ones((), jnp.int32), rep),
+        "var_counter": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        "local_interval": jax.device_put(jnp.ones((), jnp.int32), rep),
+        "local_counter": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        # telemetry: how many exact (fp32 pmean) vs 1-bit collectives the
+        # schedule actually issued — the comm-savings invariant under test
+        "exact_comms": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        "onebit_comms": jax.device_put(jnp.zeros((), jnp.int32), rep),
     }
     engine._state_shardings = jax.tree.map(
         lambda x: x.sharding, engine.opt_state)
-    log_dist(f"1-bit Adam: {total / 1e6:.1f}M params, dp={world}, "
-             f"compressed momentum after freeze_step")
+    log_dist(f"{'0/1' if zeroone else '1-bit'} Adam: "
+             f"{total / 1e6:.1f}M params, dp={world}, "
+             f"compressed collectives per the interval policy")
 
 
 def build_onebit_step(engine) -> None:
@@ -119,6 +140,12 @@ def build_onebit_step(engine) -> None:
     wd = float(p.get("weight_decay", 0.0))
     freeze_step = int(p.get("freeze_step", 100))
     is_lamb = "lamb" in cfg.optimizer.type.lower()
+    is_zeroone = _is_zeroone(cfg.optimizer.type)
+    # 0/1 Adam policy knobs (reference zoadam.py defaults)
+    var_freeze_step = int(p.get("var_freeze_step", 100000))
+    var_update_scaler = int(p.get("var_update_scaler", 16))
+    local_step_scaler = int(p.get("local_step_scaler", 32768))
+    local_step_clipper = int(p.get("local_step_clipper", 16))
     # LAMB trust-ratio clip + EMA factor (reference lamb.py max_coeff /
     # min_coeff / coeff_beta)
     coeff_max = float(p.get("max_coeff", 10.0))
@@ -201,22 +228,157 @@ def build_onebit_step(engine) -> None:
         new_flat = master1.astype(compute_dtype)
         loss = lax.pmean(jnp.mean(losses), "data")
         mnorm = jnp.sqrt(jnp.sum(jnp.square(m1)))
-        new_opt = {"master": master1, "m": m1, "v": v1,
-                   "werr": w2[None], "serr": s2[None], "step": t_new,
-                   "coeff": coeff}
+        new_opt = dict(opt, master=master1, m=m1, v=v1,
+                       werr=w2[None], serr=s2[None], step=t_new,
+                       coeff=coeff)
+        return new_flat, new_opt, loss, mnorm, lr
+
+    def body_zeroone(params, opt, batch, step, rng):
+        """0/1 Adam (reference zoadam.py:14, arXiv:2202.06009).
+
+        Phase 1 (step <= var_freeze_step) — adaptive variance updates:
+        on steps divisible by ``var_interval`` the gradient is averaged
+        EXACTLY and both moments update; on all other steps only the
+        momentum updates, from the 1-bit error-feedback-compressed
+        gradient. ``var_interval`` doubles every ``var_update_scaler``
+        variance updates, so exact collectives become exponentially rare.
+
+        Phase 2 (after the freeze) — local steps: momentum updates from
+        the LOCAL gradient and the worker takes the step with NO
+        communication, accumulating applied updates in ``u``; every
+        ``local_interval`` steps the local drift is undone, the
+        accumulated momentum is 1-bit-allreduced, and params/momentum are
+        reset from the global average (zoadam.py:246-266).
+        ``local_interval`` doubles every ``local_step_scaler`` steps,
+        clipped at ``local_step_clipper``."""
+        def micro(carry, mb):
+            acc, r = carry
+            r, sub = jax.random.split(r)
+
+            def lf(pp):
+                out = loss_fn(pp, mb, sub)
+                return out[0] if isinstance(out, tuple) else out
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            return (acc + layout.flatten_device(grads, jnp.float32), r), \
+                loss
+
+        acc0 = jnp.zeros((total,), jnp.float32)
+        (g_local, _), losses = lax.scan(micro, (acc0, rng), batch)
+        g_local = g_local * (1.0 / gas)
+
+        master, m, v, u = opt["master"], opt["m"], opt["v"], opt["u"]
+        t_new = opt["step"] + 1
+        lr = lr_schedule(step)
+        # phase-boundary error-buffer reset (zoadam.py
+        # reinitial_error_buffer: the errors switch metric from gradient
+        # to accumulated momentum)
+        at_boundary = t_new == (var_freeze_step + 1)
+        werr = jnp.where(at_boundary, 0.0, opt["werr"][0])
+        serr = jnp.where(at_boundary, 0.0, opt["serr"][0])
+        pad_z = jnp.zeros((padded - total,), jnp.float32)
+
+        def phase1(_):
+            var_step = (t_new % opt["var_interval"]) == 0
+
+            def exact(_):
+                g = lax.pmean(g_local, "data")
+                m1 = b1 * m + (1 - b1) * g
+                v1 = b2 * v + (1 - b2) * g * g
+                return (m1, v1, werr, serr,
+                        opt["exact_comms"] + 1, opt["onebit_comms"])
+
+            def onebit(_):
+                g_avg, w2, s2 = compressed_allreduce(
+                    jnp.concatenate([g_local, pad_z]), werr, serr, "data")
+                m1 = b1 * m + (1 - b1) * g_avg[:total]
+                return (m1, v, w2, s2,
+                        opt["exact_comms"], opt["onebit_comms"] + 1)
+
+            m1, v1, w2, s2, ec, oc = lax.cond(var_step, exact, onebit,
+                                              None)
+            upd = m1 / (jnp.sqrt(v1) + eps)
+            if wd:
+                upd = upd + wd * master
+            master1 = master - lr * upd
+            vc = jnp.where(var_step, opt["var_counter"] + 1,
+                           opt["var_counter"])
+            dbl = vc >= var_update_scaler
+            vi = jnp.where(dbl, opt["var_interval"] * 2,
+                           opt["var_interval"])
+            vc = jnp.where(dbl, 0, vc)
+            return (master1, m1, v1, u, opt["lrs"], w2, s2, vi, vc,
+                    opt["local_interval"], opt["local_counter"], ec, oc)
+
+        def phase2(_):
+            # local momentum + local step, zero communication
+            m1 = b1 * m + (1 - b1) * g_local
+            denom = jnp.sqrt(v) + eps
+            upd = m1 / denom
+            if wd:
+                upd = upd + wd * master
+            master1 = master - lr * upd
+            u1 = u - lr * upd
+            lrs1 = opt["lrs"] + lr
+            sync = (t_new % opt["local_interval"]) == 0
+
+            def do_sync(_):
+                # undo local drift, average the accumulated momentum
+                # (u scaled back to momentum units), re-apply globally
+                undone = master1 - u1
+                buf = u1 * denom
+                buf_avg, w2, s2 = compressed_allreduce(
+                    jnp.concatenate([buf, pad_z]), werr, serr, "data")
+                buf_avg = buf_avg[:total]
+                m2 = -buf_avg / jnp.maximum(lrs1, 1e-20)
+                p2 = undone + buf_avg / denom
+                return (p2, m2, jnp.zeros_like(u1),
+                        jnp.zeros_like(lrs1), w2, s2,
+                        opt["onebit_comms"] + 1)
+
+            def no_sync(_):
+                return (master1, m1, u1, lrs1, werr, serr,
+                        opt["onebit_comms"])
+
+            p2, m2, u2, lrs2, w2, s2, oc = lax.cond(sync, do_sync,
+                                                    no_sync, None)
+            lc = opt["local_counter"] + 1
+            dbl = lc >= local_step_scaler
+            li = jnp.where(
+                dbl, jnp.minimum(local_step_clipper,
+                                 opt["local_interval"] * 2),
+                opt["local_interval"])
+            lc = jnp.where(dbl, 0, lc)
+            return (p2, m2, v, u2, lrs2, w2, s2, opt["var_interval"],
+                    opt["var_counter"], li, lc, opt["exact_comms"], oc)
+
+        (master1, m1, v1, u1, lrs1, w2, s2, vi, vc, li, lc, ec, oc) = \
+            lax.cond(t_new > var_freeze_step, phase2, phase1, None)
+        new_flat = master1.astype(compute_dtype)
+        loss = lax.pmean(jnp.mean(losses), "data")
+        mnorm = jnp.sqrt(jnp.sum(jnp.square(m1)))
+        new_opt = dict(opt, master=master1, m=m1, v=v1, u=u1, lrs=lrs1,
+                       werr=w2[None], serr=s2[None], step=t_new,
+                       var_interval=vi, var_counter=vc,
+                       local_interval=li, local_counter=lc,
+                       exact_comms=ec, onebit_comms=oc)
         return new_flat, new_opt, loss, mnorm, lr
 
     param_specs = jax.tree.map(lambda _: P(), engine.params)
     opt_specs = {"master": P(), "m": P(), "v": P(),
                  "werr": P("data"), "serr": P("data"), "step": P(),
-                 "coeff": P()}
+                 "coeff": P(), "u": P(), "lrs": P(),
+                 "var_interval": P(), "var_counter": P(),
+                 "local_interval": P(), "local_counter": P(),
+                 "exact_comms": P(), "onebit_comms": P()}
+    step_body = body_zeroone if is_zeroone else body
 
     def fused_step(params, opt_state, scaler, batch, step, rng):
         batch_specs = jax.tree.map(
             lambda x: P(None, "data", *([None] * (np.ndim(x) - 2))),
             batch)
         new_flat, new_opt, loss, mnorm, lr = shard_map(
-            body, mesh=mesh,
+            step_body, mesh=mesh,
             in_specs=(param_specs, opt_specs, batch_specs, P(), P()),
             out_specs=(P(), opt_specs, P(), P(), P()),
             check_vma=False,

@@ -144,7 +144,9 @@ class DeepSpeedTPUEngine:
             # the 1-bit step path (ops/onebit.py) owns the update, so the
             # 1-bit-only knobs must not reach the adam factory
             _onebit_only = ("freeze_step", "max_coeff", "min_coeff",
-                            "coeff_beta")
+                            "coeff_beta", "var_freeze_step",
+                            "var_update_scaler", "local_step_scaler",
+                            "local_step_clipper")
             opt_params = {k: v for k, v in
                           (config.optimizer.params or {}).items()
                           if k not in _onebit_only}
