@@ -364,6 +364,15 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
         leaves = []
         for (path, tmpl), sh in zip(flat, sh_leaves):
             key = _SEP.join(_path_str(k) for k in path)
+            if key not in index[group]:
+                # forward compatibility: a leaf added to the runtime state
+                # after the checkpoint was written (e.g. new optimizer
+                # telemetry scalars) keeps its freshly-initialized template
+                # value instead of failing the whole restore
+                logger.warning(f"checkpoint {tag}: state leaf '{group}/{key}' "
+                         f"absent — keeping initialized value")
+                leaves.append(jax.device_put(jnp.asarray(tmpl), sh))
+                continue
             arr = jnp.asarray(_assemble(gdir, index[group][key]))
             tdtype = jnp.asarray(tmpl).dtype
             if arr.dtype != tdtype:
