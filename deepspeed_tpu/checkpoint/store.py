@@ -340,22 +340,65 @@ def _assemble(gdir: str, entry: Dict[str, Any]) -> np.ndarray:
     return out
 
 
+#: optimizer-state leaves added to the runtime AFTER older checkpoints were
+#: written (0/1 Adam accumulator + adaptive-interval policy scalars and comm
+#: telemetry) — the only leaves that may silently fall back to their
+#: freshly-initialized template value under a strict load
+_FORWARD_COMPAT_LEAVES = frozenset({
+    "u", "lrs", "var_interval", "var_counter",
+    "local_interval", "local_counter", "exact_comms", "onebit_comms",
+})
+
+
+def _missing_leaf_is_critical(group: str, key: str) -> bool:
+    """A missing 'params' leaf or any real optimizer-state leaf (fp32
+    'master' copies, Adam moments, step counter, error-feedback buffers)
+    means the checkpoint is incomplete or structurally mismatched (renamed
+    layer, truncated save) — resuming from the freshly-initialized template
+    would silently continue from partly-random state. Only the allowlisted
+    forward-compat telemetry above may fall back to the template."""
+    if group == "params":
+        return True
+    if group != "opt_state":
+        return False          # loss_scale etc.: safe to re-init
+    return key.split(_SEP, 1)[0] not in _FORWARD_COMPAT_LEAVES
+
+
 def load_checkpoint(load_dir: str, tag: Optional[str],
                     templates: Dict[str, Pytree],
-                    shardings: Dict[str, Pytree]
+                    shardings: Dict[str, Pytree],
+                    strict=True
                     ) -> Tuple[Optional[Dict[str, Pytree]],
                                Dict[str, Any], Optional[str]]:
     """Load state matching ``templates`` structure, placing each leaf with
-    the corresponding sharding (any mesh — the universal reshape)."""
+    the corresponding sharding (any mesh — the universal reshape).
+
+    ``strict`` may be ``True`` (all groups), ``False`` (none), or a
+    collection of group names: within a strict group, a missing
+    model-critical leaf ('params' leaves, fp32 masters, optimizer moments)
+    raises ``KeyError`` instead of loading partly-initialized state. A group
+    entirely absent from the checkpoint is NOT an error — that is a
+    cross-mode checkpoint (e.g. host-offload runs keep optimizer state in
+    ``host_optimizer.npz``, params-only exports); the group is omitted from
+    the returned dict so the caller can rebuild it."""
     wait_pending()
     tag = tag or latest_tag(load_dir)
     if tag is None:
         return None, {}, None
     root = os.path.join(load_dir, tag)
     meta, index = _read_merged_index(root)
+    if strict is True:
+        strict = frozenset(templates)
+    elif strict is False:
+        strict = frozenset()
 
     out: Dict[str, Pytree] = {}
     for group, template in templates.items():
+        if group not in index:
+            logger.warning(f"checkpoint {tag}: no '{group}' state group "
+                           f"(cross-mode or partial checkpoint) — caller "
+                           f"keeps/rebuilds its own state")
+            continue
         gdir = os.path.join(root, "state", group)
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
         sh_flat, _ = jax.tree_util.tree_flatten_with_path(
@@ -365,10 +408,17 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
         for (path, tmpl), sh in zip(flat, sh_leaves):
             key = _SEP.join(_path_str(k) for k in path)
             if key not in index[group]:
-                # forward compatibility: a leaf added to the runtime state
-                # after the checkpoint was written (e.g. new optimizer
-                # telemetry scalars) keeps its freshly-initialized template
-                # value instead of failing the whole restore
+                if group in strict and _missing_leaf_is_critical(group, key):
+                    raise KeyError(
+                        f"checkpoint {tag}: required state leaf "
+                        f"'{group}/{key}' is missing — the checkpoint is "
+                        f"incomplete or structurally mismatched (renamed "
+                        f"layer / truncated save). Pass strict=False to "
+                        f"keep the freshly-initialized value anyway.")
+                # forward compatibility: a non-critical leaf added to the
+                # runtime state after the checkpoint was written (e.g. new
+                # optimizer telemetry scalars) keeps its freshly-initialized
+                # template value instead of failing the whole restore
                 logger.warning(f"checkpoint {tag}: state leaf '{group}/{key}' "
                          f"absent — keeping initialized value")
                 leaves.append(jax.device_put(jnp.asarray(tmpl), sh))

@@ -51,6 +51,21 @@ Batch = Dict[str, jax.Array]
 LossFn = Callable[[Pytree, Batch, jax.Array], Any]
 
 
+def _sample_difficulty(sample) -> int:
+    """Fallback curriculum difficulty = sequence length of the first sized
+    leaf. ``len(sample)`` on a dict sample would count its KEYS — a constant
+    that silently disables difficulty gating. 0-d array leaves (scalar ids
+    etc.) are skipped: they pass ``hasattr(__len__)`` but ``len()`` raises."""
+    for leaf in jax.tree.leaves(sample):
+        if hasattr(leaf, "ndim"):          # numpy / jax array
+            if leaf.ndim:
+                return int(np.shape(leaf)[0])
+            continue
+        if hasattr(leaf, "__len__"):       # list / str sample
+            return len(leaf)
+    return 0
+
+
 @dataclass
 class ModelSpec:
     """Functional model contract consumed by the engine.
@@ -183,6 +198,7 @@ class DeepSpeedTPUEngine:
             batch_size=int(self.config.train_batch_size),
             steps_per_output=config.steps_per_print)
         self.monitor = self._build_monitor()
+        self._monitor_pending = []
         self.training_dataloader = self._build_dataloader(training_data)
         self.lr_scheduler = self.lr_schedule   # parity name
 
@@ -588,7 +604,7 @@ class DeepSpeedTPUEngine:
         micros = [next(it) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
         if self.config.check_nan_inf:
-            self._check_batch_consistency(micros)   # ALL microbatches
+            self._check_batch_consistency(micros, local=own_data)
         batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
@@ -650,21 +666,23 @@ class DeepSpeedTPUEngine:
         self._write_monitor(metrics)
         return loss
 
-    def _check_batch_consistency(self, micros) -> None:
+    def _check_batch_consistency(self, micros, local: bool = False) -> None:
         """Cross-process dataloader consistency (reference
         check_dataloader_inputs_same_across_ranks engine.py:520): every
         process must feed the same global batch or the SPMD step silently
-        trains on garbage. Hash ALL microbatches, allgather, compare."""
+        trains on garbage. Hash ALL microbatches, allgather, compare.
+
+        ``local`` is the provenance flag from ``train_batch`` (own engine
+        dataloader → per-process slices whose contents legitimately differ);
+        a size heuristic alone can't distinguish a user iterator that merely
+        happens to yield global-batch-sized leaves."""
         if jax.process_count() <= 1:
             return
         import hashlib
-        pc = jax.process_count()
-        global_b = int(self.config.train_micro_batch_size_per_gpu) \
-            * self.dp_world_size
         h = hashlib.sha256()
         for leaf in jax.tree.leaves(micros):
             leaf = np.asarray(leaf)
-            if leaf.ndim and leaf.shape[0] * pc == global_b:
+            if local and leaf.ndim:
                 # per-process local slices: contents legitimately differ;
                 # the invariant is structural (same shapes/dtypes) plus
                 # identical loader schedule, checked via seed/epoch below
@@ -864,9 +882,21 @@ class DeepSpeedTPUEngine:
             if metric is None and ds_cfg.get("metric_path"):
                 metric = np.load(ds_cfg["metric_path"])
             if metric is None:
-                metric = [len(training_data[i])
-                          if hasattr(training_data[i], "__len__") else 0
+                metric = [_sample_difficulty(training_data[i])
                           for i in range(len(training_data))]
+                if len(set(metric)) <= 1:
+                    msg = ("the fallback difficulty metric (first-array-leaf "
+                           "length) is constant over this dataset, so "
+                           "difficulty gating is a no-op — provide "
+                           "'metric_values' or 'metric_path' (reference: "
+                           "data_analyzer.py output files)")
+                    if ds_cfg.get("enabled"):
+                        # the user explicitly asked for metric-driven
+                        # sampling: a silent no-op would be a lie
+                        raise ValueError(f"data_sampling: {msg}")
+                    # curriculum-only over fixed-length data: pacing by
+                    # steps still works, difficulty gating just passes all
+                    logger.warning(f"curriculum_learning: {msg}")
             if len(metric) != len(training_data):
                 raise ValueError(
                     f"data_sampling metric has {len(metric)} entries but "
@@ -894,12 +924,28 @@ class DeepSpeedTPUEngine:
             return None
 
     def _write_monitor(self, metrics: Dict[str, jax.Array]) -> None:
+        # every step is RECORDED (the reference writes monitor events each
+        # step when enabled, engine.py:2822 — decimating would drop TB/W&B
+        # loss-curve resolution), but device scalars are held as futures and
+        # fetched in one batched device_get on reporting steps: a per-step
+        # float() here would block on the just-dispatched step and stall the
+        # async/offload-overlap pipeline (see ThroughputTimer.stop)
         if self.monitor is None or not self.monitor.enabled:
             return
-        if self.global_steps % max(1, self.config.steps_per_print):
+        self._monitor_pending.append(
+            (self.global_steps,
+             {k: v for k, v in metrics.items() if np.ndim(v) == 0}))
+        if self.global_steps % max(1, self.config.steps_per_print) == 0:
+            self._flush_monitor()
+
+    def _flush_monitor(self) -> None:
+        if not self._monitor_pending:
             return
-        events = [(f"Train/{k}", float(jax.device_get(v)), self.global_steps)
-                  for k, v in metrics.items() if np.ndim(v) == 0]
+        pending, self._monitor_pending = self._monitor_pending, []
+        fetched = jax.device_get([m for _, m in pending])   # ONE transfer
+        events = [(f"Train/{k}", float(val), step)
+                  for (step, _), vals in zip(pending, fetched)
+                  for k, val in vals.items()]
         self.monitor.write_events(events)
 
     # ------------------------------------------------------------ utilities
@@ -997,6 +1043,7 @@ class DeepSpeedTPUEngine:
         ``async_save`` commits on a background thread after a synchronous
         device→host snapshot (reference: DecoupledCheckpointEngine)."""
         from deepspeed_tpu.checkpoint.store import save_checkpoint as _save
+        self._flush_monitor()         # don't lose buffered metric events
         if self.offload_enabled:
             self._drain_host_step()   # overlapped update must land first
         tag = tag or f"global_step{self.global_steps}"
@@ -1024,6 +1071,7 @@ class DeepSpeedTPUEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
+                        load_module_strict: bool = True,
                         **_kw) -> Tuple[Optional[str], Dict[str, Any]]:
         """Reference engine.py:3273."""
         from deepspeed_tpu.checkpoint.store import load_checkpoint as _load
@@ -1031,16 +1079,28 @@ class DeepSpeedTPUEngine:
             self._drain_host_step()
         shardings = {
             "params": self._param_shardings,
-            "opt_state": self._state_shardings,
             "loss_scale": jax.tree.map(lambda _: self.plan.replicated(),
                                        self.loss_scale_state),
         }
         templates = {
             "params": self.params,
-            "opt_state": self.opt_state,
             "loss_scale": self.loss_scale_state,
         }
-        state, meta, tag = _load(load_dir, tag, templates, shardings)
+        if load_optimizer_states and not self.offload_enabled:
+            # only assemble (and strict-check) device optimizer state when it
+            # will actually be consumed — a params-only resume or a cross-mode
+            # load (offload checkpoints carry host_optimizer.npz instead)
+            # must not fail on opt_state leaves it would discard anyway
+            templates["opt_state"] = self.opt_state
+            shardings["opt_state"] = self._state_shardings
+        # load_module_strict gates MODULE (params) strictness only, as in the
+        # reference; optimizer-state completeness is never waived by it —
+        # opting out of a structural params check must not silently accept a
+        # truncated optimizer state
+        strict = frozenset(templates) if load_module_strict \
+            else frozenset(templates) - {"params"}
+        state, meta, tag = _load(load_dir, tag, templates, shardings,
+                                 strict=strict)
         if state is None:
             return None, {}
         self.params = state["params"]
@@ -1052,11 +1112,24 @@ class DeepSpeedTPUEngine:
                 # checkpoint from a non-offload run: rebuild master from
                 # the loaded params (universal reshape across offload modes)
                 self.host_optimizer.init_from(self.params)
-        elif load_optimizer_states:
-            self.opt_state = state["opt_state"]
-        ls = state["loss_scale"]
-        self.loss_scale_state = LossScaleState(*jax.tree.leaves(ls)) \
-            if not isinstance(ls, LossScaleState) else ls
+        elif load_optimizer_states and not self.offload_enabled:
+            if "opt_state" in state:
+                self.opt_state = state["opt_state"]
+            elif not self._onebit_enabled:
+                # offload-run checkpoint (optimizer lives in
+                # host_optimizer.npz) loaded into a non-offload engine:
+                # rebuild device state from the LOADED params — fresh
+                # moments, master = restored weights (mirror of the
+                # init_from branch above)
+                log_dist("checkpoint has no device opt_state group — "
+                         "rebuilding from loaded params (cross-mode resume)")
+                self.opt_state = jax.jit(
+                    self.optimizer.init,
+                    out_shardings=self._state_shardings)(self.params)
+        if "loss_scale" in state:
+            ls = state["loss_scale"]
+            self.loss_scale_state = LossScaleState(*jax.tree.leaves(ls)) \
+                if not isinstance(ls, LossScaleState) else ls
         self.global_steps = meta.get("global_steps", 0)
         self.micro_steps = meta.get("micro_steps", 0)
         self.skipped_steps = meta.get("skipped_steps", 0)

@@ -191,3 +191,57 @@ def test_dstpu_ckpt_cli(tmp_path, devices):
     data = np.load(tmp_path / "fp32.npz")
     assert "embed.tokens" in data.files
     assert data["embed.tokens"].dtype == np.float32
+
+
+def test_strict_load_rejects_missing_critical_leaves(tmp_path, devices):
+    """ADVICE r3 (medium): a checkpoint missing a 'params' or real
+    optimizer-state leaf must hard-fail under the default strict load;
+    strict=False keeps the initialized template; allowlisted forward-compat
+    telemetry leaves stay lenient either way."""
+    import json
+    import pytest
+    from deepspeed_tpu.checkpoint import store
+
+    state = {"params": {"w": np.arange(8, dtype=np.float32)},
+             "opt_state": {"exp_avg": {"w": np.zeros(8, np.float32)},
+                           "u": np.zeros((), np.float32)}}
+    store.save_checkpoint(str(tmp_path), "t", state, {})
+    meta_p0 = tmp_path / "t" / "meta.p0.json"
+    payload = json.loads(meta_p0.read_text())
+
+    sds = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    templates = {"params": {"w": np.zeros(8, np.float32),
+                            "w_new": np.zeros(4, np.float32)},
+                 "opt_state": state["opt_state"]}
+    shardings = {"params": {"w": sds, "w_new": sds},
+                 "opt_state": {"exp_avg": {"w": sds}, "u": sds}}
+    # missing params leaf → KeyError under strict
+    with pytest.raises(KeyError, match="params/w_new"):
+        store.load_checkpoint(str(tmp_path), "t", templates, shardings)
+    # strict=False → warning + initialized template
+    out, _, _ = store.load_checkpoint(str(tmp_path), "t", templates,
+                                      shardings, strict=False)
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  state["params"]["w"])
+    assert np.asarray(out["params"]["w_new"]).shape == (4,)
+
+    # missing Adam moment → also critical
+    import copy
+    broken = copy.deepcopy(payload)
+    del broken["index"]["opt_state"]["exp_avg.w"]
+    meta_p0.write_text(json.dumps(broken))
+    t2 = {"params": {"w": np.zeros(8, np.float32)},
+          "opt_state": state["opt_state"]}
+    s2 = {"params": {"w": sds},
+          "opt_state": {"exp_avg": {"w": sds}, "u": sds}}
+    with pytest.raises(KeyError, match="exp_avg"):
+        store.load_checkpoint(str(tmp_path), "t", t2, s2)
+
+    # missing allowlisted telemetry leaf ('u') → lenient even under strict
+    lenient = copy.deepcopy(payload)
+    del lenient["index"]["opt_state"]["u"]
+    meta_p0.write_text(json.dumps(lenient))
+    out, _, _ = store.load_checkpoint(str(tmp_path), "t", t2, s2)
+    np.testing.assert_array_equal(
+        np.asarray(out["opt_state"]["exp_avg"]["w"]),
+        state["opt_state"]["exp_avg"]["w"])

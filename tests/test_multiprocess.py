@@ -152,6 +152,58 @@ print("LOSSES", jax.process_index(),
 """
 
 
+_WORKER_SMOKE = """
+import sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import llama3_config
+
+ds.comm.init_distributed()
+assert len(jax.devices()) == 4, jax.devices()
+ds.build_mesh(data=4)
+cfg = llama3_config("tiny", max_seq_len=16, vocab_size=128)
+
+
+class ToyData:
+    def __init__(self):
+        r = np.random.default_rng(7)
+        self.x = r.integers(0, 128, size=(16, 16)).astype(np.int32)
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return {{"input_ids": self.x[i]}}
+
+
+eng, _, loader, _ = ds.initialize(
+    model=cfg,
+    config={{"train_micro_batch_size_per_gpu": 1,
+             "optimizer": {{"type": "adamw", "params": {{"lr": 1e-3}}}},
+             "zero_optimization": {{"stage": 1}}}},
+    rng=jax.random.PRNGKey(0),
+    training_data=ToyData())
+assert loader.local_batch == 4 // jax.process_count(), (
+    loader.local_batch, jax.process_count())
+loss = float(eng.train_batch())
+print("LOSSES", jax.process_index(), f"{{loss:.6f}}", flush=True)
+"""
+
+
+def test_two_process_dataloader_smoke(tmp_path):
+    """Fast unmarked lane coverage of the multi-host paths (per-process
+    data loading, make_array_from_process_local_data assembly, cross-process
+    loss parity): 2 procs × 2 devices, one step. The thorough variants
+    below stay @slow."""
+    outs = _run_workers(tmp_path, _WORKER_SMOKE.format(repo=_REPO),
+                        n_procs=2, devices_per_proc=2, port=29541)
+    multi = _loss_lines(outs)
+    assert len(multi) == 2 and multi[0] == multi[1], multi
+
+
 @pytest.mark.slow
 def test_two_process_training_matches_single_process(tmp_path):
     src = _WORKER_REPLICATED.format(repo=_REPO)

@@ -204,6 +204,50 @@ def test_zero_offload_checkpoint_roundtrip(tmp_path, devices):
     np.testing.assert_allclose(final, resumed, rtol=1e-6, atol=1e-7)
 
 
+def test_offload_checkpoint_into_nonoffload_engine(tmp_path, devices):
+    """Cross-mode resume (code-review r4): an offload-run checkpoint has NO
+    device opt_state group (optimizer lives in host_optimizer.npz); loading
+    it into a non-offload engine must rebuild device state from the loaded
+    params instead of raising, and resume training."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    build_mesh(data=8)
+    off_cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    rng = np.random.default_rng(2)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(2)]
+    e1, *_ = initialize(model=model, config=off_cfg,
+                        rng=jax.random.PRNGKey(9))
+    e1.train_batch(iter(batches[:1]))
+    e1.save_checkpoint(str(tmp_path))
+    saved = jax.device_get(e1.params["embed"]["tokens"])
+
+    dev_cfg = {k: v for k, v in off_cfg.items() if k != "zero_optimization"}
+    dev_cfg["zero_optimization"] = {"stage": 1}
+    e2, *_ = initialize(model=model, config=dev_cfg,
+                        rng=jax.random.PRNGKey(0))
+    tag, _ = e2.load_checkpoint(str(tmp_path))
+    assert tag is not None
+    np.testing.assert_allclose(
+        saved, jax.device_get(e2.params["embed"]["tokens"]),
+        rtol=0, atol=0)
+    # rebuilt optimizer state: fresh moments over the loaded params (fp32
+    # mode keeps no separate master — params ARE the master)
+    np.testing.assert_array_equal(
+        jax.device_get(e2.opt_state["exp_avg"]["embed"]["tokens"]), 0.0)
+    loss = float(e2.train_batch(iter(batches[1:])))
+    assert np.isfinite(loss)
+
+
 def test_zero_infinity_nvme_matches_device(tmp_path, devices):
     """ZeRO-Infinity: optimizer tier on NVMe (windowed aio sweep) must
     track the on-device Adam run, with real disk traffic (VERDICT r1 #3)."""
