@@ -85,10 +85,15 @@ def main() -> None:
         "zero_optimization": {"stage": 3 if (on_tpu and n_dev > 1) else 0},
         "bf16": {"enabled": bool(on_tpu)},
         "gradient_clipping": 1.0,
+        # save_attn_kernel keeps the Pallas kernel's (out, lse) residuals so
+        # the backward never re-runs the flash FORWARD (measured v5e: 56.3
+        # -> 57.0 MFU @2K, 46.6 -> 52.2 @16K); at 32K+ the block_in chain
+        # no longer fits alongside them, so block inputs park on host
         "activation_checkpointing": {
-            "policy": os.environ.get("DSTPU_BENCH_REMAT",
-                                     "save_attn_out" if on_tpu
-                                     else "none")},
+            "policy": os.environ.get(
+                "DSTPU_BENCH_REMAT",
+                ("offload_save_attn_kernel" if seq >= 32768
+                 else "save_attn_kernel") if on_tpu else "none")},
         # bf16 chunk logits (fp32 accumulation kept) at a 256 MB budget:
         # the optimum is ~128-token chunks — in bf16 that is half the
         # bytes, so the budget halves with the dtype (+0.7 MFU vs fp32)

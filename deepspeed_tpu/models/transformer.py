@@ -413,6 +413,16 @@ def resolve_remat_policy(name: Optional[str]):
         # attention is bandwidth-bound
         "save_attn_out":
             jax.checkpoint_policies.save_only_these_names("attn_out"),
+        # save the Pallas flash kernel's residuals (pre-projection out +
+        # lse, named inside the custom_vjp fwd) instead of the projected
+        # attn_out: same bytes (+~1% for lse), but the backward no longer
+        # re-runs the flash FORWARD kernel to rebuild them — a whole extra
+        # attention pass per layer at long sequence. Only the cheap wo
+        # projection recomputes. Pallas-attention configs only (other
+        # impls don't emit these names and would save nothing).
+        "save_attn_kernel":
+            jax.checkpoint_policies.save_only_these_names("attn_kernel_out",
+                                                          "attn_lse"),
         # also save post-rope q/k/v: backward skips the QKV projection
         # recompute at +(q_dim+2·kv·Dh)·2B per token of HBM. Helps only
         # when HBM is loose — at the 1.27B/seq2048/b8 bench point the
@@ -452,6 +462,15 @@ def resolve_remat_policy(name: Optional[str]):
         "offload_save_attn_out":
             jax.checkpoint_policies.save_and_offload_only_these_names(
                 names_which_can_be_saved=["attn_out"],
+                names_which_can_be_offloaded=["block_in"],
+                offload_src="device", offload_dst="pinned_host"),
+        # flash-kernel residuals kept in HBM (backward skips the flash
+        # FORWARD re-run entirely — see 'save_attn_kernel') + block inputs
+        # parked on host: the 32K+ sweet spot where keeping both the
+        # residual chain and the kernel outputs on device OOMs
+        "offload_save_attn_kernel":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=["attn_kernel_out", "attn_lse"],
                 names_which_can_be_offloaded=["block_in"],
                 offload_src="device", offload_dst="pinned_host"),
     }

@@ -33,6 +33,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -668,26 +669,36 @@ def _bwd_xl(q, k, v, out, lse, do, scale, causal, q_offset, block_q,
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, q_offset, block_q, block_k, window, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, causal, q_offset, block_q, block_k, window, interpret,
+           bwd_block_q, bwd_block_k):
     out, _ = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
                   block_q, block_k, window, interpret)
     return out
 
 
 def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, window,
-               interpret):
+               interpret, bwd_block_q, bwd_block_k):
     out, lse = _fwd(q, k, v, 1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
                     block_q, block_k, window, interpret)
+    # name the custom_vjp residuals so remat policies can SAVE them: with
+    # plain 'save_attn_out' (post-projection value) the backward re-runs
+    # this whole forward kernel just to rebuild (out, lse) — a full extra
+    # attention pass per layer. 'save_attn_kernel' saves these two instead
+    # (same bytes: out is B·T·d like the projected value; lse is ~1% more)
+    # and the backward recomputes only the cheap wo projection.
+    out = checkpoint_name(out, "attn_kernel_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, q_offset, block_q, block_k, window, interpret, res,
-               g):
+def _flash_bwd(causal, q_offset, block_q, block_k, window, interpret,
+               bwd_block_q, bwd_block_k, res, g):
     q, k, v, out, lse = res
     dq, dk, dv = _bwd(q, k, v, out, lse, g,
                       1.0 / math.sqrt(q.shape[-1]), causal, q_offset,
-                      block_q, block_k, window, interpret)
+                      bwd_block_q or block_q, bwd_block_k or block_k,
+                      window, interpret)
     return dq, dk, dv
 
 
@@ -736,6 +747,23 @@ def _pick_blocks(tq, tk, d, itemsize, block_q=None, block_k=None):
     return bq, bk
 
 
+def _pick_bwd_blocks(tq, tk, d, itemsize, fwd_bq, fwd_bk):
+    """Backward kernels carry more VMEM state (fp32 dq/dk/dv accumulators +
+    the extra do/delta operands), so their sweet spot differs from the
+    forward's — e.g. fwd 2048×1024 is the 16K winner but the dq kernel
+    stack-OOMs past bq 1024. Defaults to the forward blocks; override via
+    DSTPU_FLASH_BWD_BQ/BK."""
+    import os
+    bq = int(os.environ.get("DSTPU_FLASH_BWD_BQ", 0)) or fwd_bq
+    bk = int(os.environ.get("DSTPU_FLASH_BWD_BK", 0)) or fwd_bk
+    bq, bk = min(bq, tq), min(bk, tk)
+    while bq > 128 and (tq % bq or not _supported(tq, tk, d, bq, bk)):
+        bq //= 2
+    while bk > 128 and (tk % bk or not _supported(tq, tk, d, bq, bk)):
+        bk //= 2
+    return bq, bk
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     q_offset: int = 0,
@@ -769,7 +797,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * kvh, tk, d)
-    out = _flash(qf, kf, vf, causal, q_offset, bq, bk, window, interpret)
+    bwd_bq, bwd_bk = _pick_bwd_blocks(tq, tk, d, q.dtype.itemsize, bq, bk)
+    out = _flash(qf, kf, vf, causal, q_offset, bq, bk, window, interpret,
+                 bwd_bq, bwd_bk)
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
 
 

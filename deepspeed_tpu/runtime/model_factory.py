@@ -224,6 +224,7 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         # in host memory — map to the host-offload analogue of the chosen
         # recompute profile (models/transformer.resolve_remat_policy)
         upgraded = {"save_attn_out": "offload_save_attn_out",
+                    "save_attn_kernel": "offload_save_attn_kernel",
                     "save_attn_qkv": "offload_attn_qkv"}.get(
             remat, "offload_full")
         logger.info(f"cpu_checkpointing: remat policy "

@@ -311,6 +311,40 @@ def test_save_attn_qkv_remat_policy(devices):
                                losses["save_attn_qkv"], rtol=1e-5)
 
 
+def test_save_attn_kernel_remat_policy(devices):
+    """save_attn_kernel (flash custom_vjp residuals named+saved so the
+    backward skips the flash forward re-run — the r4 long-context lever)
+    and its 32K host-offload variant must train with the same loss
+    trajectory as save_attn_out: policies change memory/time, never math.
+    Forces the Pallas path (interpret-mode on CPU) so the named kernel
+    residuals are actually in the remat graph."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    cfg = llama3_config("tiny", max_seq_len=32, vocab_size=256)
+    batch = {"input_ids": np.asarray(np.random.default_rng(2).integers(
+        0, 256, size=(8, 32)), np.int32)}
+    losses = {}
+    for policy in ("save_attn_out", "save_attn_kernel",
+                   "offload_save_attn_kernel"):
+        build_mesh(data=8)
+        engine, _, _, _ = ds.initialize(
+            model=cfg,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "attention_impl": "pallas_flash",
+                    "activation_checkpointing": {"policy": policy}},
+            rng=jax.random.PRNGKey(0))
+        losses[policy] = [float(engine.train_batch(iter([batch])))
+                          for _ in range(3)]
+    np.testing.assert_allclose(losses["save_attn_out"],
+                               losses["save_attn_kernel"], rtol=1e-5)
+    np.testing.assert_allclose(losses["save_attn_out"],
+                               losses["offload_save_attn_kernel"],
+                               rtol=1e-5)
+
+
 def test_host_offload_remat_policy(devices):
     """offload_full (the reference's cpu_checkpointing: activations parked
     in pinned host DRAM between forward and backward) must train with the
