@@ -45,6 +45,134 @@ def _peak_flops(device) -> float:
     return 0.0   # CPU / unknown: MFU not meaningful
 
 
+def _run_sub(cmd, timeout):
+    """Run a sub-benchmark; return its last JSON line or an error record."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            [sys.executable] + cmd, capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "DSTPU_BENCH_SUITE": "0"})
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {"error": (out.stderr or out.stdout)[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    except Exception as e:              # never break the headline line
+        return {"error": str(e)[:400]}
+
+
+def _suite(root):
+    """The VERDICT r3 #2 'whole story' metrics: long-context 16K/32K MFU,
+    MoE training MFU, int8/int4 serving tok/s — each in its own process
+    (fresh HBM), folded into the headline line's extra.suite.
+
+    Process model: the parent's TPU client stays alive while children run.
+    That requires a runtime allowing concurrent clients (the axon/remote
+    runtime this repo benches on does — verified end-to-end, BENCH r4);
+    a locally-attached libtpu enforces single-process ownership, where
+    each child would record an error entry instead of silently lying."""
+    mfu = lambda r: {k: r.get("extra", {}).get(k) for k in
+                     ("mfu", "achieved_tflops_per_chip")} \
+        if "extra" in r else r
+    bench = os.path.join(root, "bench.py")
+    suite = {}
+    suite["long_16k"] = mfu(_run_sub(
+        [bench, "--seq", "16384", "--batch", "1", "--steps", "10"], 480))
+    suite["long_32k"] = mfu(_run_sub(
+        [bench, "--seq", "32768", "--batch", "1", "--steps", "8"], 540))
+    suite["moe_1b_8e_dropless"] = mfu(_run_sub(
+        [bench, "--mode", "moe", "--steps", "24"], 480))
+    for q in ("int8", "int4"):
+        r = _run_sub([os.path.join(root, "bench_inference.py"),
+                      "--quant", q, "--n-prompts", "12",
+                      "--new-tokens", "48"], 560)
+        suite[f"serving_{q}"] = (
+            {"ragged_tok_s": r["extra"]["ragged_tok_s"],
+             "vs_padded": r["extra"]["speedup"]}
+            if "extra" in r else r)
+    return suite
+
+
+def moe_main(args) -> None:
+    """MoE training bench: ~1B total params, 8 experts, top-2, dropless
+    (lax.ragged_dot) dispatch — MFU on ACTIVE params (the standard MoE
+    accounting; reference context: Mixtral-class EP configs)."""
+    import jax
+    dev0 = jax.devices()[0]
+    on_tpu = dev0.platform == "tpu"
+    n_dev = len(jax.devices())
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mixtral import mixtral_config
+
+    seq = args.seq or (2048 if on_tpu else 128)
+    batch = args.batch or 8
+    steps = args.steps or (24 if on_tpu else 3)
+    warmup = 3 if on_tpu else 1
+    ds.build_mesh(data=n_dev)
+    if on_tpu:
+        model = mixtral_config(
+            "tiny", hidden_size=1024, num_layers=12, num_heads=16,
+            num_kv_heads=8, intermediate_size=2816, num_experts=8,
+            num_experts_per_tok=2, vocab_size=32000, max_seq_len=seq,
+            tie_embeddings=True)
+    else:
+        model = mixtral_config("tiny", max_seq_len=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": max(1, batch // n_dev),
+        "optimizer": {"type": "adamw", "params": {
+            "lr": 1e-4, "weight_decay": 0.1,
+            **({"state_dtype": "bfloat16", "master_weights": False}
+               if on_tpu and n_dev < 8 else {})}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": bool(on_tpu)},
+        "gradient_clipping": 1.0,
+        "moe": {"impl": "dropless"},
+        "activation_checkpointing": {
+            "policy": "save_attn_kernel" if on_tpu else "none"},
+        "ce_logits_dtype": "bf16" if on_tpu else None,
+        "chunked_ce_budget_mb": 256 if on_tpu else None,
+        "steps_per_print": 1000,
+    }
+    engine, *_ = ds.initialize(model=model, config=config,
+                               rng=jax.random.PRNGKey(0))
+    gb = int(engine.config.train_batch_size)
+    rng = np.random.default_rng(0)
+    batches = [jax.device_put({"input_ids": rng.integers(
+        0, model.vocab_size, size=(gb, seq), dtype=np.int32)})
+        for _ in range(4)]
+    for i in range(warmup):
+        float(engine.train_batch(iter([batches[i % 4]])))
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(steps):
+        loss = engine.train_batch(iter([batches[i % 4]]))
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = gb * seq * steps
+    active = model.num_active_params()
+    attn = 12.0 * model.num_layers * model.hidden_size * seq * 0.5
+    achieved = (6.0 * active + attn) * tokens / dt / n_dev
+    peak = _peak_flops(dev0)
+    mfu = achieved / peak if peak else 0.0
+    print(json.dumps({
+        "metric": f"tokens/sec/chip moe-8e-top2 ~1B seq{seq} dropless",
+        "value": round(tokens / dt / n_dev, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+        "extra": {"mfu": round(mfu, 4),
+                  "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+                  "params_total_b": round(model.num_params() / 1e9, 3),
+                  "params_active_b": round(active / 1e9, 3),
+                  "loss": loss_val, "platform": dev0.platform,
+                  "n_devices": n_dev, "steps": steps,
+                  "global_batch": gb}}))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default=None,
@@ -52,7 +180,17 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--mode", default="dense", choices=("dense", "moe"))
     args = ap.parse_args()
+
+    if args.mode == "moe":
+        moe_main(args)
+        return
+    # run the full suite only on the driver-style bare invocation — explicit
+    # --seq/--batch/--steps runs are themselves sub-benchmarks or tuning
+    run_suite = (args.seq is None and args.batch is None
+                 and args.steps is None and args.size is None
+                 and os.environ.get("DSTPU_BENCH_SUITE", "1") != "0")
 
     import jax
     import jax.numpy as jnp
@@ -101,9 +239,9 @@ def main() -> None:
         "chunked_ce_budget_mb": 256 if on_tpu else None,
         "steps_per_print": 1000,
     }
-    # DSTPU_BENCH_OFFLOAD=cpu|cpu_overlap: measure the ZeRO-Offload /
-    # ZenFlow-lite host-optimizer step against the device step (the
-    # VERDICT r1 #6 'measure and report both' criterion)
+    # DSTPU_BENCH_OFFLOAD=cpu|cpu_overlap|zenflow: measure the ZeRO-Offload
+    # host-optimizer step (sync / overlapped / ZenFlow selective) against
+    # the device step (the VERDICT r1 #6 'measure and report both' criterion)
     off = os.environ.get("DSTPU_BENCH_OFFLOAD")
     if off:
         config["optimizer"]["params"].pop("state_dtype", None)
@@ -112,6 +250,10 @@ def main() -> None:
             2, config["zero_optimization"]["stage"])
         config["zero_optimization"]["offload_optimizer"] = {
             "device": "cpu", "overlap": off == "cpu_overlap"}
+        if off == "zenflow":
+            config["zero_optimization"]["zenflow"] = {
+                "topk_ratio": 0.05, "update_interval": 4,
+                "select_interval": 32, "full_warm_up_rounds": 2}
     engine, *_ = ds.initialize(model=model, config=config,
                                rng=jax.random.PRNGKey(0))
 
@@ -168,6 +310,9 @@ def main() -> None:
             "global_batch": gb,
         },
     }
+    if run_suite and on_tpu:
+        result["extra"]["suite"] = _suite(
+            os.path.dirname(os.path.abspath(__file__)))
     print(json.dumps(result))
 
 

@@ -18,17 +18,31 @@ writer, runtime/engine.py:3197–3261 and checkpoint/ds_to_universal.py:469):
    per-parameter fp32 fragments produced by ``ds_to_universal.py``. Param
    names are again module state-dict names, so the same mapping applies.
 
+Also supported (r4, VERDICT r3 #5):
+
+3. **MoE expert shards** — ``layer_<L>_expert_<E>_mp_rank_00_model_states.pt``
+   (and the legacy ``expert_<E>_mp_rank_*`` form) written by the reference's
+   MoE save path (runtime/engine.py:3111 ``_get_expert_ckpt_name``:3249).
+   Expert keys carry the DeepSpeed-MoE wrapper infix
+   ``.deepspeed_moe.experts.deepspeed_experts.<gid>.``; stripping it back to
+   ``.experts.<gid>.`` recovers the wrapped module's own naming (HF naming
+   for HF MoE models), so the same HF-interop mapping applies.
+4. **Direct ZeRO optimizer shards** —
+   ``(bf16_)zero_pp_rank_<d>_mp_rank_00_optim_states.pt``: the fp32 master
+   partitions ARE the authoritative weights of a ZeRO run; they are
+   reconstructed here exactly as the reference's offline
+   ``utils/zero_to_fp32.py`` does (Z1/2: per-group concat across dp ranks,
+   :252 ``_zero2_merge_trainable_params``; Z3: per-param zip of per-rank
+   slices, :303 ``_zero3_merge_trainable_params``) — no prior
+   ``ds_to_universal`` pass needed. Adam moments ride the same flat layout
+   and are reconstructed alongside when present.
+
 Scope, by design:
 - Model-parallel (``mp_rank_01+``) shards are rejected with instructions to
   consolidate first (the reference's own migration guidance); TP resharding
   happens on OUR side via `module_inject/auto_tp.py` partition specs after
   the full-shape weights are loaded — the AutoTP analogue shards pytrees,
   not files.
-- ZeRO optimizer shards (``zero_pp_rank_*``/``bf16_zero_*``) hold flat
-  1-D partitions whose layout is private to the reference's optimizer; the
-  reference itself converts them via ``ds_to_universal`` — import that
-  output (format 2) instead. Optimizer state is rebuilt fresh here (the
-  moments live in a different, sharding-aware layout).
 
 Requires torch (CPU) to deserialize ``.pt`` files; gated at call time.
 """
@@ -116,6 +130,9 @@ def load_ds_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],
     sd = blob.get("module", blob)
     if not isinstance(sd, dict):                     # pragma: no cover
         raise ValueError(f"unexpected model-states payload in {path}")
+    # MoE runs save expert weights in separate per-expert shard files
+    # (reference engine.py:3111); fold them back in before mapping
+    merge_expert_shards(ckpt_dir, tag, sd)
     sd = _strip_prefixes(sd)
     # ZeRO-3 model states saved without gather_16bit_weights hold 0-size
     # placeholders (params live in the zero_pp_rank_* optimizer shards) —
@@ -133,6 +150,203 @@ def load_ds_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],
     logger.info(f"imported DeepSpeed checkpoint {ckpt_dir}@{tag}: "
                 f"{cfg.num_params() / 1e6:.1f}M params")
     return cfg, params
+
+
+_MOE_INFIX = ".deepspeed_moe.experts.deepspeed_experts."
+
+
+def _natural_key(path: str):
+    import re
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", os.path.basename(path))]
+
+
+def merge_expert_shards(ckpt_dir: str, tag: str,
+                        sd: Dict[str, Any]) -> int:
+    """Fold the reference's per-expert shard files into ``sd`` (reference
+    load_moe_state_dict engine.py:3111; file naming _get_expert_ckpt_name
+    :3249). The DeepSpeed-MoE wrapper infix is stripped so keys return to
+    the wrapped module's own naming: ``<p>.deepspeed_moe.experts.
+    deepspeed_experts.<gid>.<w>`` → ``<p>.experts.<gid>.<w>``. Returns the
+    number of expert files merged."""
+    import glob as _glob
+    torch = _torch()
+    root = os.path.join(ckpt_dir, tag)
+    files = sorted(
+        _glob.glob(os.path.join(root, "layer_*_expert_*_model_states.pt"))
+        + _glob.glob(os.path.join(root, "expert_*_model_states.pt")),
+        key=_natural_key)
+    for path in files:
+        if "_mp_rank_00_" not in os.path.basename(path) and \
+                "_mp_rank_" in os.path.basename(path):
+            raise ValueError(
+                f"{path} is a model-parallel expert shard; consolidate TP "
+                f"first (same restriction as mp_rank_01 model states)")
+        esd = torch.load(path, map_location="cpu", weights_only=False)
+        esd = esd.get("model", esd)
+        for key, val in esd.items():
+            if _MOE_INFIX in key:
+                prefix, rest = key.split(_MOE_INFIX, 1)
+                key = f"{prefix}.experts.{rest}"
+            sd[key] = val
+    if files:
+        logger.info(f"merged {len(files)} reference MoE expert shards")
+    return len(files)
+
+
+def _reconstruct_flat_z2(shapes_groups, per_rank_groups) -> Dict[str, np.ndarray]:
+    """Z1/2: per-group partitions concatenated across dp ranks, then sliced
+    by param shape in declaration order (reference zero_to_fp32.py:252;
+    trailing alignment padding 0..2*world_size is simply left unread)."""
+    out: Dict[str, np.ndarray] = {}
+    for gi, shapes in enumerate(shapes_groups):
+        merged = np.concatenate(
+            [np.asarray(rank[gi], np.float32).ravel()
+             for rank in per_rank_groups])
+        off = 0
+        for name, shape in shapes.items():
+            shape = tuple(shape)
+            n = int(np.prod(shape)) if shape else 1
+            out[name] = merged[off:off + n].reshape(shape)
+            off += n
+    return out
+
+
+def _reconstruct_flat_z3(shapes_groups, per_rank_flats, world_size
+                         ) -> Dict[str, np.ndarray]:
+    """Z3: every param is partitioned per-param (padded to world_size);
+    rank r holds [offset, offset+ceil(n/ws)) of each param — zip the
+    per-rank slices back (reference zero_to_fp32.py:303)."""
+    shapes = {k: v for d in shapes_groups for k, v in d.items()}
+    ranks = [np.concatenate([np.asarray(t, np.float32).ravel()
+                             for t in flats]) for flats in per_rank_flats]
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, shape in shapes.items():
+        shape = tuple(shape)
+        n = int(np.prod(shape)) if shape else 1
+        pn = -(-n // world_size)
+        full = np.concatenate([r[off:off + pn] for r in ranks])
+        out[name] = full[:n].reshape(shape)
+        off += pn
+    return out
+
+
+def load_zero_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],
+                         tag: Optional[str] = None, dtype=np.float32,
+                         load_optimizer_states: bool = False):
+    """Import a reference ZeRO checkpoint DIRECTLY from its
+    ``zero_pp_rank_*_optim_states.pt`` shards — no ds_to_universal pass.
+
+    The fp32 master partitions in the optimizer shards are the
+    authoritative weights of a ZeRO run; reconstruction follows the
+    reference's own offline merge (utils/zero_to_fp32.py:188). With
+    ``load_optimizer_states`` (stage ≤ 2), the Adam moments — which ride
+    the identical flat layout — are reconstructed too and mapped through
+    the same HF-interop transform as the weights (layout transforms are
+    elementwise-aligned, so moments stay aligned with their weights).
+
+    Returns ``(cfg, params)`` or ``(cfg, params, moments)`` where moments
+    is ``{"exp_avg": pytree, "exp_avg_sq": pytree, "step": int}``.
+    """
+    import glob as _glob
+    torch = _torch()
+    tag = resolve_tag(ckpt_dir, tag)
+    root = os.path.join(ckpt_dir, tag)
+    files = sorted(_glob.glob(os.path.join(root, "*_optim_states.pt")),
+                   key=_natural_key)
+    if not files:
+        raise FileNotFoundError(f"no *_optim_states.pt under {root}")
+    blobs = [torch.load(f, map_location="cpu", weights_only=False)
+             for f in files]
+    osds = [b["optimizer_state_dict"] for b in blobs]
+    stage = int(osds[0]["zero_stage"])
+    world = osds[0]["partition_count"]
+    if isinstance(world, (list, tuple)):
+        world = max(world)
+    world = int(world)
+    if world != len(files):
+        raise ValueError(
+            f"expected {world} optim shards under {root}, found "
+            f"{len(files)} — incomplete checkpoint")
+
+    # param_shapes live in the model-states file (reference
+    # zero_to_fp32.get_model_state_file:68)
+    ms_name = "zero_pp_rank_0_mp_rank_00_model_states.pt" if stage == 3 \
+        else "mp_rank_00_model_states.pt"
+    ms_path = os.path.join(root, ms_name)
+    if not os.path.exists(ms_path):
+        raise FileNotFoundError(f"no model states at {ms_path}")
+    ms = torch.load(ms_path, map_location="cpu", weights_only=False)
+    shapes_groups = ms["param_shapes"]
+    if isinstance(shapes_groups, dict):
+        shapes_groups = [shapes_groups]
+
+    if stage <= 2:
+        per_rank = [osd["single_partition_of_fp32_groups"] for osd in osds]
+        fp32 = _reconstruct_flat_z2(shapes_groups, per_rank)
+    else:
+        per_rank = [osd["fp32_flat_groups"] for osd in osds]
+        fp32 = _reconstruct_flat_z3(shapes_groups, per_rank, world)
+
+    names = set(fp32.keys())
+    strip = names and all(n.startswith("module.") for n in names)
+
+    def reader(table):
+        def get(name):
+            return table["module." + name if strip else name]
+        return get
+
+    cfg = config_from_hf(hf_config)
+    vis_names = {n[len("module."):] for n in names} if strip else names
+    params = params_from_state(cfg, hf_config, reader(fp32), vis_names,
+                               dtype)
+    logger.info(f"imported reference ZeRO-{stage} checkpoint "
+                f"{ckpt_dir}@{tag}: dp={world}, "
+                f"{cfg.num_params() / 1e6:.1f}M params (direct from "
+                f"optim shards, no ds_to_universal)")
+    if not load_optimizer_states:
+        return cfg, params
+
+    if stage == 3:
+        raise ValueError(
+            "load_optimizer_states for reference stage-3 checkpoints is "
+            "not supported (sub-group moment layout); load weights only "
+            "and let the engine rebuild moments")
+    def _group_states(osd):
+        """Per-group inner Adam state. Reference key is
+        'base_optimizer_state' (checkpoint/constants.py:16) holding either
+        the torch optimizer state_dict (non-elastic, stage_1_and_2.py:2389)
+        or a per-group list (elastic, :2384 _get_base_optimizer_state);
+        'optimizer_state_dict' accepted as a fallback variant."""
+        base = osd.get("base_optimizer_state")
+        if base is None:
+            base = osd.get("optimizer_state_dict") or {}
+        if isinstance(base, list):
+            return base
+        state = base.get("state", {})
+        return [state[i] for i in sorted(state)]
+
+    moments = {}
+    for key in ("exp_avg", "exp_avg_sq"):
+        per_rank_m = []
+        for osd in osds:
+            gs = _group_states(osd)
+            if len(gs) < len(shapes_groups):
+                raise ValueError(
+                    f"optimizer shard holds {len(gs)} group states, "
+                    f"expected {len(shapes_groups)}")
+            per_rank_m.append([np.asarray(gs[i][key], np.float32)
+                               for i in range(len(shapes_groups))])
+        table = _reconstruct_flat_z2(shapes_groups, per_rank_m)
+        moments[key] = params_from_state(cfg, hf_config, reader(table),
+                                         vis_names, np.float32)
+    step = osds[0].get("base_optimizer_state_step")
+    if step is None:
+        gs = _group_states(osds[0])
+        step = gs[0].get("step", 0) if gs else 0
+    moments["step"] = int(step.item() if hasattr(step, "item") else step)
+    return cfg, params, moments
 
 
 def load_universal_checkpoint(ckpt_dir: str, hf_config: Dict[str, Any],

@@ -137,6 +137,41 @@ class OffloadOptimizerConfig(TPUConfigModel):
         return self
 
 
+class ZenFlowTPUConfig(TPUConfigModel):
+    """Reference: runtime/zenflow/zenflow_config.py (ZenFlowConfig).
+
+    Stall-free offload with selective on-device updates: the top
+    ``topk_ratio`` important gradient blocks get a synchronous device
+    AdamW every step; the tail accumulates on host and applies every
+    ``update_interval`` steps, overlapped (runtime/zero/zenflow.py)."""
+    topk_ratio: float = 0.1
+    select_strategy: str = "auto"            # parity; TPU selects by step
+    select_interval: Union[str, int] = "auto"
+    update_interval: Union[str, int] = "auto"
+    overlap_step: bool = True
+    full_warm_up_rounds: int = 2
+    #: TPU knob: importance granularity in flat elements — the reference
+    #: selects per-column (zenflow_stage_1_and_2.py); static-shape SPMD
+    #: wants fixed-size blocks of the flat parameter space instead
+    block_size: int = 4096
+    #: tail learning-rate compensation: the reference applies ONE Adam step
+    #: per update_interval on the accumulated tail gradient, so tail weights
+    #: move ~1/interval as fast as synchronous training. 'auto' scales the
+    #: tail lr by the number of accumulated steps (total movement matches
+    #: the synchronous path); 1.0 reproduces the reference exactly
+    tail_lr_scale: Union[str, float] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "ZenFlowTPUConfig":
+        if not 0.0 < self.topk_ratio <= 1.0:
+            raise ValueError("zenflow.topk_ratio must be in (0, 1]")
+        for f in ("select_interval", "update_interval"):
+            val = getattr(self, f)
+            if isinstance(val, str) and val != "auto":
+                raise ValueError(f"zenflow.{f} must be an int or 'auto'")
+        return self
+
+
 class OffloadParamConfig(TPUConfigModel):
     """Reference: runtime/zero/offload_config.py:DeepSpeedZeroOffloadParamConfig."""
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
@@ -169,6 +204,9 @@ class ZeroConfig(TPUConfigModel):
     overlap_comm: Optional[bool] = None   # XLA overlaps automatically; kept for parity
     offload_optimizer: OffloadOptimizerConfig = Field(default_factory=OffloadOptimizerConfig)
     offload_param: OffloadParamConfig = Field(default_factory=OffloadParamConfig)
+    #: ZenFlow (reference zero/config.py:171): presence enables it; needs
+    #: offload_optimizer.device='cpu'
+    zenflow: Optional[ZenFlowTPUConfig] = None
     sub_group_size: Union[int, str] = 1_000_000_000
     stage3_max_live_parameters: Union[int, str] = 1_000_000_000
     stage3_max_reuse_distance: Union[int, str] = 1_000_000_000

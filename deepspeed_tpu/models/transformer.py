@@ -226,6 +226,17 @@ class DecoderConfig:
             head += d * d + 3 * d + v
         return l * per_layer + emb + head + d
 
+    def num_active_params(self) -> int:
+        """Parameters touched per token (== num_params for dense models;
+        MoE counts experts_per_tok of the num_experts expert MLPs) — the
+        correct basis for MoE MFU/FLOPs accounting."""
+        if not self.num_experts:
+            return self.num_params()
+        d, h = self.hidden_size, self.ffn_size
+        expert = (3 if self.is_glu else 2) * d * h
+        inactive = (self.num_experts - self.num_experts_per_tok) * expert
+        return self.num_params() - self.num_layers * inactive
+
 
 # ---------------------------------------------------------------------------
 # Normalization (Pallas-accelerated versions live in deepspeed_tpu/ops)

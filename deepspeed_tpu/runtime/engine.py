@@ -150,6 +150,12 @@ class DeepSpeedTPUEngine:
             in ("cpu", "nvme"))
         self.offload_overlap = False
         self._host_future = None
+        self._zenflow = None
+        if config.zero_optimization.zenflow is not None \
+                and not self.offload_enabled:
+            raise ValueError(
+                "zenflow requires offload_optimizer.device='cpu' (the tail "
+                "optimizer lives on the host — reference zenflow engine)")
         from deepspeed_tpu.ops.onebit import ONEBIT_NAMES
         self._onebit_enabled = config.optimizer.type.lower() \
             .replace("-", "").replace("_", "") in \
@@ -435,6 +441,20 @@ class DeepSpeedTPUEngine:
                 out_shardings=self._param_shardings)
             self._host_future = None
             self._fused_step = None
+            zf_cfg = self.config.zero_optimization.zenflow
+            if zf_cfg is not None:
+                if self.fp16_enabled:
+                    raise ValueError(
+                        "zenflow requires bf16/fp32 (reference restriction:"
+                        " fp16 loss scaling needs a synchronous overflow "
+                        "signal)")
+                if self.config.zero_optimization.offload_optimizer.superoffload:
+                    raise ValueError(
+                        "zenflow and superoffload are mutually exclusive "
+                        "host-step pipelines; enable one")
+                from deepspeed_tpu.runtime.zero.zenflow import (
+                    ZenFlowCoordinator)
+                self._zenflow = ZenFlowCoordinator(self)
 
             def single_grad(params, batch, scale, rng):
                 loss, _m, grads = self._compute_loss_and_grads(
@@ -608,6 +628,16 @@ class DeepSpeedTPUEngine:
         batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
+        if self._zenflow is not None:
+            loss = self._zenflow.train_step(batch, sub)
+            self.global_steps += 1
+            self.micro_steps += gas
+            self.global_samples += int(self.config.train_batch_size)
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
+            self.tput_timer.stop(sync=loss)
+            self._write_monitor(self._last_metrics)
+            return loss
         if self.offload_enabled:
             # dispatch device fwd/bwd first (async); with overlap the host
             # Adam for the PREVIOUS step runs while this executes
@@ -781,6 +811,8 @@ class DeepSpeedTPUEngine:
 
     def _drain_host_step(self) -> None:
         """Wait for an in-flight overlapped host step and apply it."""
+        if getattr(self, "_zenflow", None) is not None:
+            self._zenflow.drain()
         if getattr(self, "_host_future", None) is not None:
             fut, self._host_future = self._host_future, None
             self._apply_host_result(fut.result())

@@ -127,3 +127,155 @@ def test_universal_checkpoint_module_prefix(tmp_path):
     cfg, params = load_universal_checkpoint(str(tmp_path),
                                             model.config.to_dict(), tag=tag)
     _assert_logits_parity(model, cfg, params)
+
+
+def _tiny_mixtral():
+    from transformers import MixtralConfig, MixtralForCausalLM
+    cfg = MixtralConfig(hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, vocab_size=256,
+                        max_position_embeddings=128,
+                        num_local_experts=4, num_experts_per_tok=2,
+                        rms_norm_eps=1e-6)
+    torch.manual_seed(3)
+    return MixtralForCausalLM(cfg).eval()
+
+
+def test_moe_expert_shard_import(tmp_path):
+    """VERDICT r3 #5: a reference MoE checkpoint stores expert weights in
+    per-expert shard files with the deepspeed_moe wrapper infix (engine.py
+    :3111, :3249); import must fold them back and match HF logits."""
+    model = _tiny_mixtral()
+    tag = "global_step5"
+    d = tmp_path / tag
+    d.mkdir(parents=True)
+    sd = dict(model.state_dict())
+    infix = ".deepspeed_moe.experts.deepspeed_experts."
+    # split expert weights out exactly as the reference writes them
+    expert_files = {}
+    for key in list(sd):
+        if ".block_sparse_moe.experts." in key:
+            prefix, rest = key.split(".experts.", 1)
+            eid, wname = rest.split(".", 1)
+            layer = int(prefix.split(".")[2])
+            ds_key = f"{prefix}{infix}{eid}.{wname}"
+            expert_files.setdefault((layer, int(eid)), {})[ds_key] = \
+                sd.pop(key)
+    assert expert_files, "expert split found nothing — naming drifted"
+    torch.save({"module": sd, "global_steps": 5},
+               str(d / "mp_rank_00_model_states.pt"))
+    for (layer, eid), esd in expert_files.items():
+        torch.save(esd, str(
+            d / f"layer_{layer}_expert_{eid}_mp_rank_00_model_states.pt"))
+    (tmp_path / "latest").write_text(tag)
+
+    cfg, params = load_ds_checkpoint(str(tmp_path), model.config.to_dict())
+    assert cfg.num_experts == 4
+    from functools import partial
+    from deepspeed_tpu.parallel.moe import moe_layer
+    moe_fn = partial(moe_layer, top_k=2, capacity_factor=8.0,
+                     drop_tokens=False, aux_loss_coef=0.0, ep_axis=None)
+    tokens = np.arange(1, 17, dtype=np.int32)[None].repeat(2, 0)
+    hidden, _ = transformer.forward_hidden(
+        cfg, jax.tree.map(jnp.asarray, params), jnp.asarray(tokens),
+        moe_fn=moe_fn)
+    ours = np.asarray(transformer.lm_logits(
+        cfg, jax.tree.map(jnp.asarray, params), hidden))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens.astype(np.int64))
+                       ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def _write_zero2_ckpt(model, root, tag="global_step7", world=2,
+                      moment_scale=0.5):
+    """Synthetic reference Z2 checkpoint: fp32 master flat partitions in
+    zero_pp_rank_* optim shards + param_shapes in the model states file
+    (format per utils/zero_to_fp32.py:252)."""
+    import collections
+    import math
+    d = root / tag
+    d.mkdir(parents=True)
+    sd = model.state_dict()
+    shapes = collections.OrderedDict(
+        (k, tuple(v.shape)) for k, v in sd.items())
+    flat = torch.cat([v.reshape(-1).float() for v in sd.values()])
+    align = 2 * world
+    padded = math.ceil(flat.numel() / align) * align
+    flat = torch.nn.functional.pad(flat, (0, padded - flat.numel()))
+    part = padded // world
+    torch.save({"module": {k: v.to(torch.bfloat16) for k, v in sd.items()},
+                "param_shapes": [shapes]},
+               str(d / "mp_rank_00_model_states.pt"))
+    for r in range(world):
+        chunk = flat[r * part:(r + 1) * part].clone()
+        # the real writer nests the inner Adam state under
+        # 'base_optimizer_state' (checkpoint/constants.py:16)
+        torch.save({"optimizer_state_dict": {
+            "zero_stage": 2,
+            "partition_count": world,
+            "single_partition_of_fp32_groups": [chunk],
+            "base_optimizer_state": {
+                "state": {0: {"step": 7,
+                              "exp_avg": chunk * moment_scale,
+                              "exp_avg_sq": (chunk * moment_scale) ** 2}},
+                "param_groups": [{}],
+            },
+        }}, str(d / f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+    (root / "latest").write_text(tag)
+
+
+def test_zero2_direct_optim_states_import(tmp_path):
+    """VERDICT r3 #5: zero_pp_rank_* optim shards import directly (no
+    ds_to_universal): fp32 master → weights with HF-logit parity; Adam
+    moments ride the identical flat layout and must stay elementwise
+    aligned with their weights through the HF-interop mapping."""
+    model = _tiny_llama()
+    _write_zero2_ckpt(model, tmp_path, world=2, moment_scale=0.5)
+    from deepspeed_tpu.checkpoint.ds_import import load_zero_checkpoint
+    cfg, params, moments = load_zero_checkpoint(
+        str(tmp_path), model.config.to_dict(), load_optimizer_states=True)
+    _assert_logits_parity(model, cfg, params)
+    assert moments["step"] == 7
+    # moments were written as 0.5*master: after the identical mapping the
+    # moment tree must equal 0.5*params, leaf for leaf
+    flat_p = jax.tree.leaves(params)
+    flat_m = jax.tree.leaves(moments["exp_avg"])
+    assert len(flat_p) == len(flat_m)
+    for p, m in zip(flat_p, flat_m):
+        np.testing.assert_allclose(np.asarray(m), 0.5 * np.asarray(p),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zero2_import_into_training_engine(tmp_path):
+    """Roundtrip 'done' criterion: synthetic reference Z2 checkpoint →
+    import → training engine resumes (params + moments) with finite,
+    decreasing loss."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.checkpoint.ds_import import load_zero_checkpoint
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    model = _tiny_llama()
+    _write_zero2_ckpt(model, tmp_path, world=2)
+    cfg, params, moments = load_zero_checkpoint(
+        str(tmp_path), model.config.to_dict(), load_optimizer_states=True)
+
+    build_mesh(data=8)
+    eng, *_ = ds.initialize(
+        model=cfg,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}},
+        params=jax.tree.map(jnp.asarray, params),
+        rng=jax.random.PRNGKey(0))
+    # seed the imported moments into the engine's optimizer state
+    eng.opt_state["exp_avg"] = jax.tree.map(
+        jnp.asarray, moments["exp_avg"])
+    eng.opt_state["exp_avg_sq"] = jax.tree.map(
+        jnp.asarray, moments["exp_avg_sq"])
+    eng.opt_state["step"] = jnp.int32(moments["step"])
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, size=(8, 32),
+                                       dtype=np.int32)}
+    losses = [float(eng.train_batch(iter([batch]))) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
