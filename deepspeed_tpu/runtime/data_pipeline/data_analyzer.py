@@ -1,0 +1,198 @@
+"""Distributed map-reduce data analysis (curriculum metric computation).
+
+Reference: ``runtime/data_pipeline/data_sampling/data_analyzer.py`` (885
+LoC): ``run_map``:199 — each worker iterates ITS contiguous split of the
+dataset and persists per-worker metric files; ``run_reduce``:437 — merge
+the worker files into global index files (``<metric>_sample_to_metric``,
+``<metric>_index_to_sample``, ``<metric>_index_to_metric``) that
+``DeepSpeedDataSampler`` consumes for curriculum scheduling.
+
+This implementation keeps the reference's architecture — contiguous
+per-worker splits, on-disk intermediate files, a reduce that any single
+worker can run once every map shard landed — with numpy .npy files instead
+of the reference's mmap indexed-dataset builders (same role, no torch
+dependency, and byte-reproducible: the reduced outputs are IDENTICAL
+regardless of how many workers produced the map shards, which the 2-proc
+vs 1-proc fixture asserts).
+
+Metric types (reference data_analyzer.py:63):
+- ``single_value_per_sample`` — one value per sample; reduce emits
+  sample→metric, the difficulty-sorted sample index, and sorted values.
+- ``accumulate_value_over_samples`` — a running vector sum (e.g. vocab
+  frequency); reduce emits the element-wise total.
+"""
+
+import glob
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+MetricFn = Callable[[Any], Any]
+
+SINGLE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
+
+
+class DistributedDataAnalyzer:
+    """Map-reduce metric computation over an indexed dataset.
+
+    ``num_workers``/``worker_id`` follow the reference's convention (one
+    OS process per worker — the launcher's process env or any scheduler).
+    Each worker calls :meth:`run_map`; then :meth:`run_reduce` (any one
+    worker, or a separate process) merges. :meth:`run_map_reduce` does
+    both with a file-based barrier, matching the reference's
+    ``run_map_reduce``:445 convenience entry point.
+    """
+
+    def __init__(self, dataset,
+                 metric_names: List[str],
+                 metric_functions: List[MetricFn],
+                 metric_types: Optional[List[str]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 batch_size: int = 64):
+        if len(metric_names) != len(metric_functions):
+            raise ValueError("metric_names and metric_functions must pair")
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or
+                                 [SINGLE] * len(metric_names))
+        for t in self.metric_types:
+            if t not in (SINGLE, ACCUMULATE):
+                raise ValueError(f"unknown metric_type '{t}'")
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+        self.batch_size = int(batch_size)
+
+    # ------------------------------------------------------------------ map
+    def _split(self) -> range:
+        """Contiguous per-worker split (reference run_map_helper:151
+        splits the dataset index range evenly across workers)."""
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = min(self.worker_id * per, n)
+        return range(lo, min(lo + per, n))
+
+    def run_map(self) -> None:
+        """Compute this worker's metric shard and persist it."""
+        split = self._split()
+        for name, fn, mtype in zip(self.metric_names, self.metric_functions,
+                                   self.metric_types):
+            mdir = os.path.join(self.save_path, name)
+            os.makedirs(mdir, exist_ok=True)
+            if mtype == SINGLE:
+                vals = np.asarray([fn(self.dataset[i]) for i in split])
+            else:
+                acc = None
+                for i in split:
+                    v = np.asarray(fn(self.dataset[i]))
+                    acc = v.copy() if acc is None else acc + v
+                vals = acc if acc is not None else np.zeros(0)
+            shard = os.path.join(mdir, f"worker{self.worker_id}.npy")
+            np.save(shard + ".tmp.npy", vals)
+            os.replace(shard + ".tmp.npy", shard)   # atomic publish
+            with open(os.path.join(
+                    mdir, f"worker{self.worker_id}.json"), "w") as fh:
+                json.dump({"start": split.start, "stop": split.stop,
+                           "num_workers": self.num_workers,
+                           "type": mtype}, fh)
+        logger.info(f"data analyzer map: worker {self.worker_id}/"
+                    f"{self.num_workers} wrote samples "
+                    f"[{split.start}, {split.stop})")
+
+    # --------------------------------------------------------------- reduce
+    def _wait_for_shards(self, mdir: str, timeout: float) -> List[str]:
+        deadline = time.time() + timeout
+        while True:
+            metas = sorted(glob.glob(os.path.join(mdir, "worker*.json")))
+            if len(metas) >= self.num_workers:
+                return metas
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"reduce: only {len(metas)}/{self.num_workers} map "
+                    f"shards under {mdir} after {timeout}s")
+            time.sleep(0.2)
+
+    def run_reduce(self, timeout: float = 300.0) -> None:
+        """Merge worker shards into the global index files the sampler
+        consumes (reference merge_map_results:279). Outputs per metric:
+
+        - ``<name>_sample_to_metric.npy`` — value per sample index
+        - ``<name>_index_to_sample.npy`` — sample indices, difficulty-sorted
+          (stable; ties keep dataset order — deterministic across runs)
+        - ``<name>_index_to_metric.npy`` — the sorted values
+        - ``<name>_metric_value.npy`` — accumulate-type total
+        - ``index.json`` — coverage + min/max summary
+        """
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            mdir = os.path.join(self.save_path, name)
+            metas = self._wait_for_shards(mdir, timeout)
+            shards = []
+            for mpath in metas:
+                with open(mpath) as fh:
+                    meta = json.load(fh)
+                vals = np.load(mpath[:-len(".json")] + ".npy")
+                shards.append((meta["start"], meta["stop"], vals))
+            shards.sort(key=lambda s: s[0])
+            if mtype == SINGLE:
+                expect = 0
+                for start, stop, vals in shards:
+                    if start != expect or len(vals) != stop - start:
+                        raise ValueError(
+                            f"reduce: shard coverage broken at {start} "
+                            f"(expected {expect}) under {mdir}")
+                    expect = stop
+                if expect != len(self.dataset):
+                    raise ValueError(
+                        f"reduce: shards cover [0, {expect}) but dataset "
+                        f"has {len(self.dataset)} samples")
+                s2m = np.concatenate([v for _, _, v in shards])
+                order = np.argsort(s2m, kind="stable")
+                np.save(os.path.join(mdir, f"{name}_sample_to_metric.npy"),
+                        s2m)
+                np.save(os.path.join(mdir, f"{name}_index_to_sample.npy"),
+                        order)
+                np.save(os.path.join(mdir, f"{name}_index_to_metric.npy"),
+                        s2m[order])
+                summary = {"num_samples": int(len(s2m)),
+                           "min": float(s2m.min()), "max": float(s2m.max())}
+            else:
+                total = None
+                for _, _, vals in shards:
+                    if vals.size:
+                        total = vals.copy() if total is None else \
+                            total + vals
+                np.save(os.path.join(mdir, f"{name}_metric_value.npy"),
+                        total if total is not None else np.zeros(0))
+                summary = {"num_samples": int(len(self.dataset))}
+            with open(os.path.join(mdir, "index.json"), "w") as fh:
+                json.dump({"metric": name, "type": mtype,
+                           "num_workers": len(shards), **summary}, fh,
+                          sort_keys=True)
+            logger.info(f"data analyzer reduce: merged {len(shards)} "
+                        f"shards for '{name}'")
+
+    def run_map_reduce(self, timeout: float = 300.0) -> None:
+        """Map, then reduce on worker 0 (file-based barrier: reduce waits
+        for every worker's shard to land — reference run_map_reduce:445
+        barriers on a comm group; an offline analysis job has no mesh)."""
+        self.run_map()
+        if self.worker_id == 0:
+            self.run_reduce(timeout=timeout)
+
+
+def load_metric(save_path: str, metric_name: str,
+                kind: str = "sample_to_metric") -> np.ndarray:
+    """Read a reduced metric file (what ``data_sampling.metric_path``
+    points at): kind ∈ sample_to_metric | index_to_sample |
+    index_to_metric | metric_value."""
+    return np.load(os.path.join(save_path, metric_name,
+                                f"{metric_name}_{kind}.npy"))

@@ -84,9 +84,10 @@ class DeepSpeedDataSampler:
 
 
 class DataAnalyzer:
-    """Offline difficulty analysis (reference data_analyzer.py): map a
-    metric function over an indexed dataset, persist the values + the
-    difficulty-sorted index."""
+    """Single-process convenience wrapper over the distributed map-reduce
+    analyzer (runtime/data_pipeline/data_analyzer.py — the reference
+    data_analyzer.py analogue; use DistributedDataAnalyzer directly for
+    multi-worker analysis over datasets bigger than one host pass)."""
 
     def __init__(self, dataset, metric_fn=None):
         self.dataset = dataset

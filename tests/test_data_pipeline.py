@@ -261,3 +261,144 @@ def test_engine_curriculum_sampler_wiring():
         tag, _ = eng.load_checkpoint(d)
         assert tag is not None
         assert eng.data_sampler.consumed_samples == consumed
+
+
+def test_distributed_data_analyzer_two_proc_byte_identical(tmp_path):
+    """VERDICT r3 #6 'done' criterion: a 2-process map + reduce must
+    produce byte-identical metric/index files to a 1-process run, and the
+    curriculum sampler consumes them. Workers are REAL OS processes
+    coordinating only through the save_path files (the reference's
+    worker model, data_analyzer.py:199/:437)."""
+    import subprocess
+    import sys
+
+    worker_src = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DistributedDataAnalyzer)
+
+
+class Ds:
+    def __len__(self):
+        return 103                     # deliberately not divisible by 2
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return {{"input_ids": np.arange(1 + (i * 7) % 29),
+                 "tok": rng.integers(0, 8, size=4)}}
+
+
+def seq_len(sample):
+    return len(sample["input_ids"])
+
+
+def tok_hist(sample):
+    return np.bincount(sample["tok"], minlength=8)
+
+
+DistributedDataAnalyzer(
+    Ds(), metric_names=["seqlen", "vocab"],
+    metric_functions=[seq_len, tok_hist],
+    metric_types=["single_value_per_sample",
+                  "accumulate_value_over_samples"],
+    save_path={save!r}, num_workers={nw}, worker_id={wid},
+).run_map_reduce(timeout=120)
+"""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(save, nw):
+        procs = []
+        for wid in range(nw):
+            f = tmp_path / f"w{nw}_{wid}.py"
+            f.write_text(worker_src.format(repo=repo, save=str(save),
+                                           nw=nw, wid=wid))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(f)],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, out[-2000:]
+
+    run(tmp_path / "one", 1)
+    run(tmp_path / "two", 2)
+
+    reduced = ["seqlen/seqlen_sample_to_metric.npy",
+               "seqlen/seqlen_index_to_sample.npy",
+               "seqlen/seqlen_index_to_metric.npy",
+               "vocab/vocab_metric_value.npy"]
+    for rel in reduced:
+        a = (tmp_path / "one" / rel).read_bytes()
+        b = (tmp_path / "two" / rel).read_bytes()
+        assert a == b, f"{rel} differs between 1-proc and 2-proc"
+
+    # the sampler consumes the reduced metric values
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import load_metric
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DeepSpeedDataSampler)
+    vals = load_metric(str(tmp_path / "two"), "seqlen")
+    assert len(vals) == 103
+    cur = CurriculumScheduler({
+        "curriculum_type": "fixed_linear", "min_difficulty": 5,
+        "max_difficulty": 29,
+        "schedule_config": {"total_curriculum_step": 10,
+                            "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler(vals, batch_size=8, curriculum=cur,
+                                   dp_rank=0, dp_world=1, seed=0,
+                                   micro_steps_per_global_step=1)
+    batch = next(iter(sampler))
+    assert all(vals[i] <= 29 for i in batch)
+
+
+def test_engine_metric_path_consumes_reduced_file(tmp_path):
+    """data_sampling.metric_path pointed at the analyzer's reduced
+    sample_to_metric file wires into the engine dataloader."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        DistributedDataAnalyzer)
+
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": rng.integers(0, 256, size=(32,), dtype=np.int32)}
+            for _ in range(64)]
+
+    class Ds:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return data[i]
+
+    DistributedDataAnalyzer(
+        Ds(), metric_names=["difficulty"],
+        metric_functions=[lambda s: float(i_sum(s))],
+        save_path=str(tmp_path)).run_map_reduce()
+
+    build_mesh(data=8)
+    eng, _, loader, _ = ds.initialize(
+        model=gpt2_config("tiny", max_seq_len=32, vocab_size=256),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "data_efficiency": {
+                "enabled": True,
+                "data_sampling": {
+                    "enabled": True,
+                    "metric_path": str(
+                        tmp_path / "difficulty" /
+                        "difficulty_sample_to_metric.npy")}},
+        },
+        rng=jax.random.PRNGKey(0), training_data=Ds())
+    assert eng.data_sampler is not None
+    assert np.isfinite(float(eng.train_batch()))
+
+
+def i_sum(sample):
+    return int(sample["input_ids"].sum()) % 97
