@@ -305,9 +305,36 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         from deepspeed_tpu.runtime.pipe.pipeline import (
             pipeline_partition_specs, pipelined_loss,
             pipelined_loss_and_grads_1f1b)
-        assert dec_cfg.num_layers % stages == 0, (
-            f"num_layers {dec_cfg.num_layers} not divisible by pipeline "
-            f"stages {stages}")
+        # balanced partition for L % S != 0 (reference PipelineModule
+        # partition_balanced, pipe/module.py:393): pad the stacked layers
+        # to S·ceil(L/S) with zero (identity) layers and mask them — every
+        # stage runs ceil(L/S) real-or-dummy layers, so the tick critical
+        # path equals the reference's balanced split (max stage cost);
+        # dummy layers are value-identity with exactly-zero grads.
+        # Embed/head never imbalance stages here: both are computed
+        # replicated across 'pipe' by construction (the reference weighs
+        # them into the split because ITS stages own them exclusively).
+        import math as _math
+        _L = dec_cfg.num_layers
+        _cap = _math.ceil(_L / stages)
+        _pad = _cap * stages - _L
+        pipe_layer_mask = None
+        if _pad:
+            import numpy as _np
+            pipe_layer_mask = _np.arange(_cap * stages) < _L
+            _base_init = init_fn
+
+            def init_fn(rng):                            # noqa: F811
+                p = dict(_base_init(rng))
+                p["layers"] = jax.tree.map(
+                    lambda a: jnp.pad(
+                        a, [(0, _pad)] + [(0, 0)] * (a.ndim - 1)),
+                    p["layers"])
+                return p
+            logger.info(
+                f"pipeline: {_L} layers over {stages} stages — balanced "
+                f"split via {_pad} masked padding layer(s), "
+                f"{_cap}/stage critical path")
         if not dec_cfg.causal or not dec_cfg.prenorm:
             # the pipeline stages assume the pre-LN decoder layout
             # (final_norm leaf, causal attention); silently pipelining a
@@ -373,7 +400,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                                   remat_policy=remat or "full",
                                   num_stages=stages,
                                   ce_budget_bytes=ce_budget,
-                                  ce_logits_dtype=ce_dtype)
+                                  ce_logits_dtype=ce_dtype,
+                                  layer_mask=pipe_layer_mask)
 
         if ds_cfg.pipeline.schedule == "1f1b":
             def pipeline_grad_fn(params, batch, rng, scale):
@@ -383,7 +411,8 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                     scale=scale, attn_fn=pipe_attn,
                     moe_fn=_moe_for_step(rng),
                     remat_policy=remat or "full", num_stages=stages,
-                    ce_budget_bytes=ce_budget, ce_logits_dtype=ce_dtype)
+                    ce_budget_bytes=ce_budget, ce_logits_dtype=ce_dtype,
+                    layer_mask=pipe_layer_mask)
         elif ds_cfg.pipeline.schedule != "gpipe":
             raise ValueError(
                 f"pipeline.schedule must be '1f1b' or 'gpipe', got "

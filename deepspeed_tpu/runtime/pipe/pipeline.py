@@ -89,20 +89,36 @@ def _apply_embed(cfg: DecoderConfig, em, tok, positions):
 
 
 def _stage_forward(cfg: DecoderConfig, local_layers, x, sin, cos,
-                   attn_fn, moe_fn, remat_policy: Optional[str]):
-    """Run this stage's L/S layers (scan, optional per-block remat)."""
+                   attn_fn, moe_fn, remat_policy: Optional[str],
+                   local_mask=None):
+    """Run this stage's ceil(L/S) layers (scan, optional per-block remat).
+
+    ``local_mask`` ([C] bool) marks PADDING layers inactive — the balanced
+    partition for L % S != 0 (reference PipelineModule partition_balanced,
+    module.py:393): every stage runs the same static layer count (SPMD
+    over 'pipe' — the tick critical path is max stage cost, exactly what
+    the reference's balanced split minimizes), and a padded stage's dummy
+    iterations are value-identity with exactly-zero parameter gradients."""
     block = partial(transformer.decoder_block, cfg, attn_fn=attn_fn,
                     moe_fn=moe_fn)
 
-    def body(carry, layer_params):
+    def body(carry, inp):
+        if local_mask is None:
+            layer_params = inp
+        else:
+            layer_params, active = inp
         carry = checkpoint_name(carry, "block_in")
         out, aux = block(layer_params, carry, sin, cos)
+        if local_mask is not None:
+            out = jnp.where(active, out, carry)
+            aux = jnp.where(active, aux, 0.0)
         return out, aux
 
     if remat_policy and remat_policy != "none":
         body = jax.checkpoint(
             body, policy=transformer.resolve_remat_policy(remat_policy))
-    x, aux = lax.scan(body, x, local_layers)
+    xs = local_layers if local_mask is None else (local_layers, local_mask)
+    x, aux = lax.scan(body, x, xs)
     return x, jnp.sum(aux)
 
 
@@ -111,11 +127,13 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
                    remat_policy: Optional[str] = None,
                    mesh=None, num_stages: Optional[int] = None,
                    ce_budget_bytes: Optional[int] = None,
-                   ce_logits_dtype=None):
+                   ce_logits_dtype=None, layer_mask=None):
     """tokens/labels: [M, B, T] stacked microbatches → scalar token-mean CE.
 
     Must be called under jit with ``params['layers']`` sharded over 'pipe'
-    on the leading axis (pipeline_partition_specs).
+    on the leading axis (pipeline_partition_specs). ``layer_mask`` ([L']
+    bool, L' = S·ceil(L/S)): balanced partition for indivisible layer
+    counts — see _stage_forward.
     """
     from deepspeed_tpu.parallel.mesh import get_mesh
     mesh = mesh or get_mesh()
@@ -124,7 +142,8 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
     M, b, t = tokens.shape
     d = cfg.hidden_size
 
-    def per_stage(local_layers, embed, final_norm, head, tokens, labels):
+    def per_stage(local_layers, local_mask, embed, final_norm, head,
+                  tokens, labels):
         sid = lax.axis_index("pipe")
         positions = jnp.broadcast_to(
             jnp.arange(t, dtype=jnp.int32)[None], (b, t))
@@ -148,7 +167,8 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
             mb_in = min(step, M - 1)           # microbatch entering stage 0
             x_in = jnp.where(sid == 0, embed_mb(tokens[mb_in]), buf)
             x_out, aux = _stage_forward(cfg, local_layers, x_in, sin, cos,
-                                        attn_fn, moe_fn, remat_policy)
+                                        attn_fn, moe_fn, remat_policy,
+                                        local_mask)
             valid = jnp.logical_and(step >= sid,
                                     step - sid < M).astype(jnp.float32)
             # each stage's aux covers only its own L/S layers, so the psum
@@ -180,25 +200,30 @@ def pipelined_loss(cfg: DecoderConfig, params, tokens, labels,
 
     head = _pack_head(params)
     embed_in = _pack_embed(cfg, params)
+    n_stacked = jax.tree.leaves(params["layers"])[0].shape[0]
+    mask = jnp.ones((n_stacked,), bool) if layer_mask is None \
+        else jnp.asarray(layer_mask, bool)
     base_specs = (
         jax.tree.map(lambda _: P("pipe"), params["layers"]),
+        P("pipe"),
         jax.tree.map(lambda _: P(), embed_in),
         jax.tree.map(lambda _: P(), params["final_norm"]),
     )
     if head is None:
-        def entry(local_layers, embed, final_norm, tokens, labels):
-            return per_stage(local_layers, embed, final_norm, None,
-                             tokens, labels)
+        def entry(local_layers, local_mask, embed, final_norm, tokens,
+                  labels):
+            return per_stage(local_layers, local_mask, embed, final_norm,
+                             None, tokens, labels)
         fn = jax.shard_map(entry, mesh=mesh,
                            in_specs=base_specs + (P(), P()),
                            out_specs=P(), axis_names={"pipe"})
-        return fn(params["layers"], embed_in, params["final_norm"],
+        return fn(params["layers"], mask, embed_in, params["final_norm"],
                   tokens, labels)
     fn = jax.shard_map(per_stage, mesh=mesh,
                        in_specs=base_specs
                        + (jax.tree.map(lambda _: P(), head), P(), P()),
                        out_specs=P(), axis_names={"pipe"})
-    return fn(params["layers"], embed_in, params["final_norm"],
+    return fn(params["layers"], mask, embed_in, params["final_norm"],
               head, tokens, labels)
 
 
@@ -213,7 +238,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
                                   mesh=None,
                                   num_stages: Optional[int] = None,
                                   ce_budget_bytes: Optional[int] = None,
-                                  ce_logits_dtype=None):
+                                  ce_logits_dtype=None, layer_mask=None):
     """One-forward-one-backward pipeline step → (loss, grads).
 
     Reference ``schedule.py:189`` (TrainSchedule): each tick a stage runs
@@ -243,7 +268,8 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
     K = min(M, 2 * S - 1)
     T = M + 2 * (S - 1)
 
-    def per_stage(local_layers, embed, final_norm, head, tokens, labels):
+    def per_stage(local_layers, local_mask, embed, final_norm, head,
+                  tokens, labels):
         sid = lax.axis_index("pipe")
         is_last = (sid == S - 1)
         positions = jnp.broadcast_to(
@@ -258,7 +284,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
 
         def stage_fn(ly, x):
             y, aux = _stage_forward(cfg, ly, x, sin, cos, attn_fn, moe_fn,
-                                    remat_policy)
+                                    remat_policy, local_mask)
             # for dense models aux is a CONSTANT zero — invariant on
             # 'pipe' — and jax.vjp would then reject the varying cotangent
             # seed below; one zero-valued element of x makes it varying
@@ -394,16 +420,19 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
     head = _pack_head(params)
     embed_in = _pack_embed(cfg, params)
-    in_specs = (layer_specs, rep(embed_in),
+    n_stacked = jax.tree.leaves(params["layers"])[0].shape[0]
+    mask = jnp.ones((n_stacked,), bool) if layer_mask is None \
+        else jnp.asarray(layer_mask, bool)
+    in_specs = (layer_specs, P("pipe"), rep(embed_in),
                 rep(params["final_norm"]))
     if head is None:
-        def entry(ll, em, fn_, tk, lb):
-            return per_stage(ll, em, fn_, None, tk, lb)
+        def entry(ll, lm, em, fn_, tk, lb):
+            return per_stage(ll, lm, em, fn_, None, tk, lb)
         out = jax.shard_map(
             entry, mesh=mesh, in_specs=in_specs + (P(), P()),
             out_specs=(P(), layer_specs, rep(embed_in),
                        rep(params["final_norm"])),
-            axis_names={"pipe"})(params["layers"], embed_in,
+            axis_names={"pipe"})(params["layers"], mask, embed_in,
                                  params["final_norm"], tokens, labels)
         loss, g_layers, g_embed, g_norm = out
         grads = {"layers": g_layers, "embed": g_embed,
@@ -413,7 +442,7 @@ def pipelined_loss_and_grads_1f1b(cfg: DecoderConfig, params, tokens,
             per_stage, mesh=mesh, in_specs=in_specs + (rep(head), P(), P()),
             out_specs=(P(), layer_specs, rep(embed_in),
                        rep(params["final_norm"]), rep(head)),
-            axis_names={"pipe"})(params["layers"], embed_in,
+            axis_names={"pipe"})(params["layers"], mask, embed_in,
                                  params["final_norm"], head, tokens,
                                  labels)
         loss, g_layers, g_embed, g_norm, g_head = out

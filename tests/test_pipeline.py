@@ -63,6 +63,64 @@ def test_pipeline_matches_dp(devices):
                                atol=3e-4)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_balanced_partition_uneven_layers(schedule, devices):
+    """VERDICT r3 #8: L %% S != 0 (here 3 layers over 2 stages) runs via
+    the balanced masked-padding split and MATCHES the data-parallel
+    baseline's losses — the dummy padding layer is value-identity with
+    zero grads, and the tick critical path is ceil(L/S) (what the
+    reference's partition_balanced minimizes, pipe/module.py:393)."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB,
+                        num_layers=3)
+    data = _batches(8, seed=11)
+
+    build_mesh(data=8)
+    e0, *_ = initialize(model=model, config=_cfg(1, 1, 4),
+                        rng=jax.random.PRNGKey(7))
+    it = iter(data)
+    base_losses = [float(e0.train_batch(it)) for _ in range(2)]
+
+    build_mesh(data=4, pipe=2)
+    cfg = _cfg(2, 2, 4)
+    cfg["pipeline"]["schedule"] = schedule
+    e1, *_ = initialize(model=model, config=cfg,
+                        rng=jax.random.PRNGKey(7))
+    # padded stacked layers: 4 rows, last one masked dummy
+    n_stacked = jax.tree.leaves(e1.params["layers"])[0].shape[0]
+    assert n_stacked == 4
+    it = iter(data)
+    pipe_losses = [float(e1.train_batch(it)) for _ in range(2)]
+    np.testing.assert_allclose(pipe_losses, base_losses, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_pipeline_tied_embeddings_across_stages(devices):
+    """General tied leaves (reference TiedLayerSpec, pipe/module.py:77):
+    with tie_embeddings the SAME leaf serves stage-0 embedding and the
+    last-stage LM head; it lives replicated over 'pipe' and its gradient
+    is the psum of both uses — training must match the DP baseline."""
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB,
+                        tie_embeddings=True)
+    assert model.tie_embeddings
+    data = _batches(8, seed=13)
+
+    build_mesh(data=8)
+    e0, *_ = initialize(model=model, config=_cfg(1, 1, 4),
+                        rng=jax.random.PRNGKey(5))
+    it = iter(data)
+    base_losses = [float(e0.train_batch(it)) for _ in range(2)]
+
+    build_mesh(data=4, pipe=2)
+    cfg = _cfg(2, 2, 4)
+    cfg["pipeline"]["schedule"] = "1f1b"
+    e1, *_ = initialize(model=model, config=cfg,
+                        rng=jax.random.PRNGKey(5))
+    it = iter(data)
+    pipe_losses = [float(e1.train_batch(it)) for _ in range(2)]
+    np.testing.assert_allclose(pipe_losses, base_losses, rtol=3e-4,
+                               atol=3e-4)
+
+
 def test_pipeline_host_offload_remat_matches(devices):
     """offload_full on the PP path (stage scan names its carry 'block_in')
     must reproduce the plain-remat pipeline losses — the host round-trip
