@@ -1,4 +1,4 @@
-"""Autotuner — micro-batch / ZeRO-config search.
+"""Autotuner — micro-batch / ZeRO-config search with an HBM memory model.
 
 Reference: ``autotuning/autotuner.py:42`` (``Autotuner``: builds a space of
 micro-batch sizes × ZeRO stages (+offload), launches short experiment runs,
@@ -8,9 +8,17 @@ launches through the DeepSpeed launcher; on TPU a candidate is just an
 engine construction + a few jitted steps in-process — the measurement is
 identical (steps/sec after compile warmup) without the process plumbing.
 
-OOM-safe: a candidate that fails to build or step (RESOURCE_EXHAUSTED) is
-recorded as infeasible and the sweep continues — the reference does the
-same via experiment exit codes.
+Memory model (reference FAST mode: ``_get_model_info``/mem estimates prune
+the space BEFORE launching): per candidate, predict device HBM from
+abstract shapes — params/grads/optimizer state divided by their ZeRO
+sharding factors, plus a remat-policy-dependent activation estimate and
+the CE-chunk workspace — and skip predicted-infeasible configs without
+building them. On a real chip each skipped candidate saves an engine
+build + compile + RESOURCE_EXHAUSTED unwind (minutes on a v5e).
+
+Candidates that pass the model but still fail at run time are recorded as
+infeasible and the sweep continues — the reference does the same via
+experiment exit codes.
 """
 
 import copy
@@ -31,10 +39,101 @@ class TuneResult:
     throughput: float           #: samples/sec (0 → infeasible)
     step_time: float
     error: Optional[str] = None
+    #: True when the memory model rejected the candidate WITHOUT building
+    predicted_oom: bool = False
+    #: memory-model breakdown in bytes (also set for measured candidates)
+    predicted_hbm: Optional[Dict[str, float]] = None
 
     @property
     def feasible(self) -> bool:
         return self.error is None
+
+
+def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,
+                           seq_len: Optional[int] = None) -> Dict[str, float]:
+    """Predict per-device HBM for one candidate from abstract shapes only
+    (nothing is allocated). Returns a component breakdown plus 'total'.
+
+    Model (coarse by design, mirrored on the reference's FAST-mode
+    activation/model-state estimates):
+      params   — compute-dtype leaves; stage 3 shards them over the data
+                 axes, MiCS over 'data_inner'.
+      grads    — one transient compute-dtype copy; reduce-scattered (so
+                 sharded) at stage ≥ 2.
+      opt      — Adam family: fp32 master (unless master_weights=False or
+                 params already fp32) + two moments in state_dtype; sharded
+                 at stage ≥ 1; 0 on device when offloaded to cpu/nvme.
+      acts     — scan-carry residuals per layer per token by remat policy
+                 + one block's recompute working set + CE chunk workspace.
+    """
+    zo = config.get("zero_optimization", {}) or {}
+    stage = int(zo.get("stage", 0))
+    off_dev = (zo.get("offload_optimizer", {}) or {}).get("device", "none")
+    bf16 = bool((config.get("bf16", {}) or {}).get("enabled"))
+    p_bytes = 2 if bf16 else 4
+    opt_p = (config.get("optimizer", {}) or {}).get("params", {}) or {}
+    state_bytes = 2 if str(opt_p.get("state_dtype", "")).startswith("bf") \
+        else 4
+    master = opt_p.get("master_weights", True) and bf16
+
+    d = dec_cfg.hidden_size
+    ffn = dec_cfg.ffn_size
+    L = dec_cfg.num_layers
+    V = dec_cfg.vocab_size
+    T = seq_len or dec_cfg.max_seq_len
+    B = int(config.get("train_micro_batch_size_per_gpu", 1))
+    N = dec_cfg.num_params()
+
+    dp = mesh.shape.get("data", 1) * mesh.shape.get("data_inner", 1)
+    mics = int(zo.get("mics_shard_size", 0) or 0)
+    param_shard = (mics if mics > 1 else dp) if stage >= 3 else 1
+    grad_shard = dp if stage >= 2 else 1
+    opt_shard = dp if stage >= 1 else 1
+
+    params = N * p_bytes / param_shard
+    grads = N * p_bytes / grad_shard
+    if off_dev in ("cpu", "nvme"):
+        opt = 0.0
+    else:
+        opt = N * ((4 if master else 0) + 2 * state_bytes) / opt_shard
+
+    # residuals saved per layer per token (bytes / d), by policy
+    policy = (config.get("activation_checkpointing", {}) or {}) \
+        .get("policy") or "none"
+    act = 2 if dec_cfg.is_glu else 1   # silu_glu keeps 3·ffn recompute live
+    per_layer_d = {
+        "full": 1.0, "offload_full": 0.0,
+        "offload_attn_out": 1.0, "offload_attn_qkv": 1.0,
+        "save_attn_out": 2.0, "save_attn_kernel": 2.0,
+        "offload_save_attn_out": 1.0, "offload_save_attn_kernel": 1.0,
+        "save_attn_qkv": 2.0 + (dec_cfg.q_dim
+                                + 2 * dec_cfg.kv_heads * dec_cfg.head_dim) / d,
+        # no remat: everything lives until backward
+        "none": 6.0 + act * 3.0 * ffn / d,
+        "dots_saveable": 4.0 + act * 1.5 * ffn / d,
+        "nothing_saveable": 1.0,
+        "dots_with_no_batch_dims_saveable": 1.0,
+    }.get(policy, 2.0)
+    carry = L * B * T * d * p_bytes * per_layer_d
+    working = B * T * (4 * d + 3 * ffn) * p_bytes     # one block recompute
+    ce_mb = config.get("chunked_ce_budget_mb")
+    ce = (int(ce_mb) * 2 ** 20 * 2 if ce_mb
+          else B * T * V * (2 if config.get("ce_logits_dtype") else 4))
+    total = (params + grads + opt + carry + working + ce) * 1.15  # fudge
+    return {"params": params, "grads": grads, "opt": opt,
+            "activations": carry + working, "ce": ce, "total": total}
+
+
+def device_hbm_bytes(default: Optional[int] = None) -> Optional[int]:
+    """Per-chip HBM capacity, from the backend when it reports one."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:
+        pass
+    return default
 
 
 class Autotuner:
@@ -52,7 +151,9 @@ class Autotuner:
                  remat_policies: Optional[List[str]] = None,
                  ce_budgets_mb: Optional[List[int]] = None,
                  steps: int = 5, warmup: int = 2,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 hbm_bytes: Optional[int] = None,
+                 memory_model: bool = True):
         self.model = model
         self.base_config = base_config
         self.batch_fn = batch_fn
@@ -65,7 +166,19 @@ class Autotuner:
         self.steps = steps
         self.warmup = warmup
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        #: per-chip HBM budget for the memory model; auto-detected from the
+        #: backend when it reports a limit (CPU virtual meshes don't — pass
+        #: explicitly to exercise pruning there)
+        self.hbm_bytes = hbm_bytes if hbm_bytes is not None \
+            else device_hbm_bytes()
+        self.memory_model = memory_model and self.hbm_bytes is not None
         self.results: List[TuneResult] = []
+
+    def _decoder_config(self):
+        dc = getattr(self.model, "decoder_config", None)
+        if dc is not None:
+            return dc
+        return self.model if hasattr(self.model, "num_params") else None
 
     def _candidates(self) -> Iterator[Dict[str, Any]]:
         for stage in self.zero_stages:
@@ -115,11 +228,30 @@ class Autotuner:
             return TuneResult(config=cfg, throughput=0.0, step_time=0.0,
                               error=str(e)[:500])
 
+    def _predict(self, cfg: Dict[str, Any]) -> Optional[TuneResult]:
+        """Memory-model gate: return a predicted-OOM result (skip the
+        build entirely) or None when the candidate fits the HBM budget."""
+        dec = self._decoder_config()
+        if not self.memory_model or dec is None:
+            return None
+        from deepspeed_tpu.parallel.mesh import get_mesh
+        est = estimate_candidate_hbm(dec, cfg, get_mesh())
+        if est["total"] <= self.hbm_bytes:
+            return None
+        return TuneResult(
+            config=cfg, throughput=0.0, step_time=0.0,
+            error=(f"predicted OOM: {est['total'] / 2**30:.2f} GiB > "
+                   f"{self.hbm_bytes / 2**30:.2f} GiB HBM "
+                   f"(params {est['params'] / 2**30:.2f}, opt "
+                   f"{est['opt'] / 2**30:.2f}, acts "
+                   f"{est['activations'] / 2**30:.2f})"),
+            predicted_oom=True, predicted_hbm=est)
+
     def tune(self, results_dir: Optional[str] = None) -> TuneResult:
         """Run the sweep; returns the best feasible candidate (reference
         autotuner 'tune' + results json output)."""
         for cfg in self._candidates():
-            res = self._measure(cfg)
+            res = self._predict(cfg) or self._measure(cfg)
             self.results.append(res)
             extras = ""
             ac = cfg.get("activation_checkpointing", {}).get("policy")
@@ -143,7 +275,9 @@ class Autotuner:
                 json.dump([{"config": r.config,
                             "throughput": r.throughput,
                             "step_time": r.step_time,
-                            "error": r.error} for r in self.results],
+                            "error": r.error,
+                            "predicted_oom": r.predicted_oom}
+                           for r in self.results],
                           fh, indent=1)
             with open(os.path.join(results_dir, "autotune_best.json"),
                       "w") as fh:
