@@ -151,11 +151,17 @@ class DeepSpeedTPUEngine:
         self.offload_overlap = False
         self._host_future = None
         self._zenflow = None
+        self._param_stream = None
         if config.zero_optimization.zenflow is not None \
                 and not self.offload_enabled:
             raise ValueError(
                 "zenflow requires offload_optimizer.device='cpu' (the tail "
                 "optimizer lives on the host — reference zenflow engine)")
+        if config.zero_optimization.zenflow is not None and \
+                config.zero_optimization.offload_param.device.value != "none":
+            raise ValueError(
+                "zenflow and offload_param are mutually exclusive "
+                "streaming schedules; enable one")
         from deepspeed_tpu.ops.onebit import ONEBIT_NAMES
         self._onebit_enabled = config.optimizer.type.lower() \
             .replace("-", "").replace("_", "") in \
@@ -272,6 +278,18 @@ class DeepSpeedTPUEngine:
             # ZeRO-Offload: optimizer state in host DRAM; ZeRO-Infinity:
             # on NVMe via the windowed aio sweep (runtime/zero/infinity.py)
             off_cfg = self.config.zero_optimization.offload_optimizer
+            param_tier = self.config.zero_optimization.offload_param \
+                .device.value
+            if param_tier != "none" and off_cfg.device.value == "cpu" \
+                    and not off_cfg.superoffload:
+                # the param tier stores master/params/grads in ONE
+                # file-backed tier; 'cpu' maps it onto /dev/shm (DRAM)
+                import dataclasses as _dc
+                from deepspeed_tpu.config.config import OffloadDeviceEnum
+                off_cfg = off_cfg.model_copy(update={
+                    "device": OffloadDeviceEnum.nvme,
+                    "nvme_path": off_cfg.nvme_path or
+                    f"/dev/shm/dstpu_tier_{os.getpid()}"})
             if off_cfg.device.value == "nvme":
                 from deepspeed_tpu.runtime.zero.infinity import (
                     DEFAULT_WINDOW, NVMeOffloadOptimizer)
@@ -299,6 +317,11 @@ class DeepSpeedTPUEngine:
             self.host_optimizer.init_from(self.params)
             self.opt_state = {}
             self._state_shardings = {}
+            self._param_stream = None
+            if param_tier != "none":
+                from deepspeed_tpu.runtime.zero.param_stream import (
+                    ParamStreamCoordinator)
+                self._param_stream = ParamStreamCoordinator(self)
             return
         self.host_optimizer = None
         if self._onebit_enabled:
@@ -628,8 +651,9 @@ class DeepSpeedTPUEngine:
         batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
-        if self._zenflow is not None:
-            loss = self._zenflow.train_step(batch, sub)
+        if self._param_stream is not None or self._zenflow is not None:
+            runner = self._param_stream or self._zenflow
+            loss = runner.train_step(batch, sub)
             self.global_steps += 1
             self.micro_steps += gas
             self.global_samples += int(self.config.train_batch_size)
@@ -1079,8 +1103,10 @@ class DeepSpeedTPUEngine:
         if self.offload_enabled:
             self._drain_host_step()   # overlapped update must land first
         tag = tag or f"global_step{self.global_steps}"
+        params = self.params if self._param_stream is None \
+            else self._param_stream.full_params_np()
         state = {
-            "params": self.params,
+            "params": params,
             "opt_state": self.opt_state,
             "loss_scale": self.loss_scale_state,
         }
@@ -1109,6 +1135,36 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.checkpoint.store import load_checkpoint as _load
         if self.offload_enabled:
             self._drain_host_step()
+        if self._param_stream is not None:
+            # tier mode: params land on the HOST (cpu backend) and seed the
+            # file store — the whole point is they don't fit device HBM
+            cpu0 = jax.local_devices(backend="cpu")[0]
+            sds = jax.sharding.SingleDeviceSharding(cpu0)
+            tmpl = jax.tree.map(
+                lambda s: np.zeros(s.shape, s.dtype),
+                self._param_stream._abstract)
+            state, meta, tag = _load(
+                load_dir, tag, {"params": tmpl},
+                {"params": jax.tree.map(lambda _: sds, tmpl)},
+                strict=frozenset({"params"}) if load_module_strict
+                else frozenset())
+            if state is None:
+                return None, {}
+            with jax.default_device(cpu0):
+                self._param_stream._seed_store(
+                    jax.tree.map(jnp.asarray, state["params"]))
+            host_path = os.path.join(load_dir, tag, "host_optimizer.npz")
+            if load_optimizer_states and os.path.exists(host_path):
+                self.host_optimizer.load_state_dict(dict(np.load(host_path)))
+            else:
+                # cross-mode checkpoint: rebuild the tiered master from
+                # the loaded params (moments start fresh)
+                self.host_optimizer.init_from(state["params"])
+            self._param_stream._reload_resident()
+            self.global_steps = meta.get("global_steps", 0)
+            self.micro_steps = meta.get("micro_steps", 0)
+            self.global_samples = meta.get("global_samples", 0)
+            return tag, meta.get("client_state", {})
         shardings = {
             "params": self._param_shardings,
             "loss_scale": jax.tree.map(lambda _: self.plan.replicated(),

