@@ -130,7 +130,7 @@ def moe_main(args) -> None:
         "zero_optimization": {"stage": 0},
         "bf16": {"enabled": bool(on_tpu)},
         "gradient_clipping": 1.0,
-        "moe": {"impl": "dropless"},
+        "moe": {"impl": os.environ.get("DSTPU_BENCH_MOE_IMPL", "dropless")},
         "activation_checkpointing": {
             "policy": "save_attn_kernel" if on_tpu else "none"},
         "ce_logits_dtype": "bf16" if on_tpu else None,
