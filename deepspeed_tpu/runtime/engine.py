@@ -587,6 +587,10 @@ class DeepSpeedTPUEngine:
                 "forward()/backward()/step() are not supported with "
                 "pipeline parallelism or the ZeRO++ quantized path; use "
                 "train_batch() (reference pipe/engine.py restriction)")
+        if self._param_stream is not None:
+            raise RuntimeError(
+                "forward()/backward()/step() are not supported under "
+                "offload_param (layer-streamed schedule); use train_batch()")
         self._rng, sub = jax.random.split(self._rng)
         batch = self._place_batch(batch)
         loss, grads = self._grad_step(self.params, batch,
@@ -763,7 +767,15 @@ class DeepSpeedTPUEngine:
         """Forward-only loss over one global batch — no gradients, no
         state change (reference PipelineEngine.eval_batch / engine eval
         usage). Works in every engine mode, including ZeRO++ flat storage
-        (params unflattened on the fly) and pipeline (GPipe loss fn)."""
+        (params unflattened on the fly), pipeline (GPipe loss fn), and the
+        offload_param tier (forward-only layer streaming)."""
+        if self._param_stream is not None:
+            if data_iter is None:
+                raise ValueError("eval_batch needs an explicit data_iter")
+            gas = int(self.config.gradient_accumulation_steps)
+            losses = [self._param_stream.eval_step(next(data_iter))
+                      for _ in range(gas)]
+            return jnp.mean(jnp.stack(losses))
         if self.offload_enabled:
             self._drain_host_step()     # overlap mode: apply the pending
             #                             update or we'd eval stale weights

@@ -213,6 +213,7 @@ class ParamStreamCoordinator:
             return loss, dx, dres
 
         self._j_head_vjp = jax.jit(head_vjp)
+        self._j_head_loss = jax.jit(head_loss)
 
         def embed_vjp(em, tokens, dx):
             _, vjp = jax.vjp(lambda e: embed_fwd(e, tokens), em)
@@ -308,6 +309,22 @@ class ParamStreamCoordinator:
         eng._last_metrics = {"grad_norm": gnorm, "overflow": 0, "lr": lr,
                              "loss": loss}
         return loss
+
+    def eval_step(self, batch) -> jax.Array:
+        """Forward-only streamed loss (evaluation for models whose params
+        don't fit HBM — same layer streaming as training, no stash/vjp)."""
+        tokens = jnp.asarray(batch["input_ids"])
+        if tokens.ndim == 3:
+            tokens = tokens[0]
+        labels = batch.get("labels")
+        labels = jnp.asarray(labels[0] if labels is not None
+                             and np.ndim(labels) == 3 else labels) \
+            if labels is not None else jnp.concatenate(
+                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        x = self._j_embed(self.resident, tokens)
+        for l in range(self.lr_ranges.num_layers):
+            x = self._j_layer(self._fetch_layer(l), x, tokens)
+        return self._j_head_loss(self.resident, x, labels)
 
     # ---------------------------------------------------------------- update
     def _optimizer_sweep(self, lr: float, clip_scale: float) -> None:

@@ -503,3 +503,31 @@ def test_param_tier_checkpoint_roundtrip(tmp_path, devices):
     assert tag is not None
     resumed = [float(e2.train_batch(iter([b]))) for b in batches[1:]]
     np.testing.assert_allclose(resumed, cont, rtol=1e-5, atol=1e-6)
+
+
+def test_param_tier_eval_batch_streams(tmp_path, devices):
+    """eval under the param tier is forward-only layer streaming — and
+    must match the plain engine's eval loss on identical weights."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(6)
+    batch = {"input_ids": rng.integers(0, 256, size=(8, 32),
+                                       dtype=np.int32)}
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e0, *_ = initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}},
+        rng=jax.random.PRNGKey(21))
+    ref = float(e0.eval_batch(iter([batch])))
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e1, *_ = initialize(model=model,
+                        config=_param_tier_cfg(tmp_path, device="cpu"),
+                        rng=jax.random.PRNGKey(21))
+    got = float(e1.eval_batch(iter([batch])))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
