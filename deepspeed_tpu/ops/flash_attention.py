@@ -865,12 +865,24 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     hdiv = 1
     for a in head_axes:
         hdiv *= mesh.shape[a]
-    # GQA grouping is only correct when q AND kv heads shard identically
+    # GQA grouping is only correct when q AND kv heads shard identically.
+    # Indivisible counts first try the uneven-head treatment (static head
+    # padding / minimal KV replication, exact grads — parallel/ulysses.
+    # _even_heads, the reference uneven_heads_all2all analogue) so the
+    # full head split survives; only exotic shapes degrade.
+    orig_h = h
     if head_axes and (h % hdiv or kvh % hdiv):
-        head_axes = tuple(a for a in ("model",) if mesh.shape[a] > 1)
-        hdiv = mesh.shape["model"] if head_axes else 1
-        if head_axes and (h % hdiv or kvh % hdiv):
-            head_axes, hdiv = (), 1
+        from deepspeed_tpu.parallel.ulysses import _even_heads
+        evened = _even_heads(q, k, v, hdiv)
+        if evened is not None:
+            q, k, v, orig_h = evened
+            h, kvh = q.shape[2], k.shape[2]
+        else:
+            head_axes = tuple(a for a in ("model",)
+                              if mesh.shape[a] > 1)
+            hdiv = mesh.shape["model"] if head_axes else 1
+            if head_axes and (h % hdiv or kvh % hdiv):
+                head_axes, hdiv = (), 1
     if b % max(bdiv, 1):
         batch_axes, bdiv = (), 1
 
@@ -891,4 +903,7 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     fn = jax.shard_map(lambda a, b_, c: local(a, b_, c),
                        mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, axis_names=manual, check_vma=False)
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    if out.shape[2] != orig_h:
+        out = out[:, :, :orig_h, :]   # drop padded query heads
+    return out

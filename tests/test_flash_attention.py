@@ -75,6 +75,40 @@ def test_sharded_flash_matches_reference(topo, devices):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("shape", [
+    (8, 2, dict(data=2, model=2, seq=2)),   # GQA: kv 2 < model*seq 4
+    (8, 2, dict(data=2, seq=4)),            # GQA: kv 2 < sp 4
+    (2, 2, dict(data=2, model=2, seq=2)),   # MHA: q itself indivisible
+])
+def test_sharded_flash_uneven_heads(shape, devices):
+    """The Pallas wrapper keeps the full head split for indivisible head
+    counts via the uneven-head treatment (same as parallel/ulysses) —
+    values AND grads match local attention; no degrade to model-only."""
+    from deepspeed_tpu.ops.flash_attention import flash_attention_sharded
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    import jax.numpy as jnp
+    h, kvh, topo = shape
+    build_mesh(**topo)
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.normal(size=(2, 128, h, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 128, kvh, 32)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 128, kvh, 32)), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    fn = lambda a, b, c: flash_attention_sharded(
+        a, b, c, block_q=64, block_k=64, interpret=True)
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    gref = jax.grad(lambda a, b, c: jnp.sum(
+        dot_product_attention(a, b, c, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gout = jax.jit(jax.grad(lambda a, b, c: jnp.sum(fn(a, b, c) ** 2),
+                            argnums=(0, 1, 2)))(q, k, v)
+    for gr, go in zip(gref, gout):
+        np.testing.assert_allclose(np.asarray(go), np.asarray(gr),
+                                   rtol=5e-5, atol=5e-5)
+
+
 def test_chunked_cross_entropy_matches_full():
     from deepspeed_tpu.models.llama import llama3_config
     from deepspeed_tpu.models.transformer import (chunked_cross_entropy,
