@@ -15,10 +15,12 @@ flat numpy buffer (the reference's flat partition layout). Each step:
    as ONE flat device_put; a jitted unflatten restores the params pytree
    with its shardings.
 
-With ``offload_optimizer.overlap`` (ZenFlow-lite) the host step for step t
-runs while the device computes step t+1's gradients — the device never
-stalls on the host; updates apply one step late (accuracy-neutral per the
-ZenFlow results, reference blog: removes >60% of step idle time).
+With ``offload_optimizer.overlap`` the host step for step t runs while
+the device computes step t+1's gradients — the device never stalls on
+the host; updates apply one step late. The FULL ZenFlow design
+(selective on-device top-k updates + interval host tail, reference
+runtime/zenflow/) lives in ``runtime/zero/zenflow.py`` and builds on
+this optimizer.
 
 This trades step latency for HBM: the device holds only compute-dtype
 params + transient grads — the config that lets a 16G v5e train models
