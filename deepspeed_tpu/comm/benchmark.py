@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
@@ -70,7 +70,7 @@ def bench_collective(op: str, numel: int, mesh: Optional[Mesh] = None,
     fn = _collective_fn(op, axis, n)
     mapped = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-        check_rep=False))
+        check_vma=False))
 
     x = jax.device_put(
         jnp.zeros((numel * n,), dtype=dtype),
