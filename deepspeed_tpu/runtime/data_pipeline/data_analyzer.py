@@ -99,22 +99,44 @@ class DistributedDataAnalyzer:
             shard = os.path.join(mdir, f"worker{self.worker_id}.npy")
             np.save(shard + ".tmp.npy", vals)
             os.replace(shard + ".tmp.npy", shard)   # atomic publish
-            with open(os.path.join(
-                    mdir, f"worker{self.worker_id}.json"), "w") as fh:
+            # The meta json must land atomically too: a concurrent reducer
+            # polls for this exact filename and must never see a partial
+            # write (it is the map->reduce barrier token).
+            meta_path = os.path.join(mdir, f"worker{self.worker_id}.json")
+            with open(meta_path + ".tmp", "w") as fh:
                 json.dump({"start": split.start, "stop": split.stop,
                            "num_workers": self.num_workers,
                            "type": mtype}, fh)
+            os.replace(meta_path + ".tmp", meta_path)
         logger.info(f"data analyzer map: worker {self.worker_id}/"
                     f"{self.num_workers} wrote samples "
                     f"[{split.start}, {split.stop})")
 
     # --------------------------------------------------------------- reduce
-    def _wait_for_shards(self, mdir: str, timeout: float) -> List[str]:
+    def _wait_for_shards(self, mdir: str, timeout: float
+                         ) -> Dict[str, dict]:
+        """Poll until every worker's meta json is present and parsable;
+        return {path: parsed meta}, ordered by path."""
         deadline = time.time() + timeout
+        metas: Dict[str, dict] = {}
         while True:
-            metas = sorted(glob.glob(os.path.join(mdir, "worker*.json")))
+            for mpath in sorted(glob.glob(os.path.join(mdir,
+                                                       "worker*.json"))):
+                if mpath in metas:   # atomic publish: valid stays valid
+                    continue
+                # Publishes are atomic (os.replace), but tolerate a shard
+                # from an older non-atomic writer or a torn NFS view:
+                # an unparsable meta is "not landed yet", retried until
+                # the deadline rather than crashing the reducer.
+                # ValueError covers JSONDecodeError AND the
+                # UnicodeDecodeError a garbage-bytes read raises.
+                try:
+                    with open(mpath) as fh:
+                        metas[mpath] = json.load(fh)
+                except (ValueError, OSError):
+                    continue
             if len(metas) >= self.num_workers:
-                return metas
+                return dict(sorted(metas.items()))
             if time.time() > deadline:
                 raise TimeoutError(
                     f"reduce: only {len(metas)}/{self.num_workers} map "
@@ -136,9 +158,7 @@ class DistributedDataAnalyzer:
             mdir = os.path.join(self.save_path, name)
             metas = self._wait_for_shards(mdir, timeout)
             shards = []
-            for mpath in metas:
-                with open(mpath) as fh:
-                    meta = json.load(fh)
+            for mpath, meta in metas.items():
                 vals = np.load(mpath[:-len(".json")] + ".npy")
                 shards.append((meta["start"], meta["stop"], vals))
             shards.sort(key=lambda s: s[0])

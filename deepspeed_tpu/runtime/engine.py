@@ -153,7 +153,11 @@ class DeepSpeedTPUEngine:
         self._zenflow = None
         self._param_stream = None
         if config.zero_optimization.zenflow is not None \
-                and not self.offload_enabled:
+                and config.zero_optimization.offload_optimizer.device.value \
+                != "cpu":
+            # 'nvme' must be rejected too: NVMeOffloadOptimizer keeps
+            # master/moments on disk (master=None), which the ZenFlow
+            # selection/tail sweep cannot address.
             raise ValueError(
                 "zenflow requires offload_optimizer.device='cpu' (the tail "
                 "optimizer lives on the host — reference zenflow engine)")

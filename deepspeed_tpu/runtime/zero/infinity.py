@@ -58,13 +58,17 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
         # pre-size every file SYNCHRONOUSLY before any aio touches it:
         # ftruncate both zero-fills the moments (sparse) and removes the
         # fallback writer's create-vs-write race on fresh files
-        nbytes = self.layout.total * 4
-        for path in self.files.values():
-            with open(path, "wb") as fh:
-                fh.truncate(nbytes)
+        for name in self.files:
+            self._zero_file(name)
         log_dist(f"ZeRO-Infinity NVMe tier at {nvme_path}: "
                  f"{self.layout.total * 12 / 2**30:.2f} GiB optimizer state "
                  f"on disk, window {self.window / 1e6:.1f}M elements")
+
+    def _zero_file(self, name: str) -> None:
+        """(Re)create ``files[name]`` as a zero-filled (sparse) file of the
+        full state size."""
+        with open(self.files[name], "wb") as fh:
+            fh.truncate(self.layout.total * 4)
 
     # the full master never lives in RAM
     def init_from(self, params: Pytree) -> None:
@@ -74,6 +78,12 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
             self.aio.pwrite(self.files["master"],
                             flat[off:off + n].copy(), off * 4)
         self.aio.drain()
+        # a mid-process rebuild (cross-mode restore) must also zero the
+        # on-disk moments and the step count, or the next sweep resumes
+        # with stale Adam state from steps taken before the restore
+        for name in ("exp_avg", "exp_avg_sq"):
+            self._zero_file(name)
+        self.adam.step_count = 0
         self.bytes_written += self.layout.total * 4
         self.master = None
 

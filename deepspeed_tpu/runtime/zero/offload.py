@@ -142,7 +142,18 @@ class HostOffloadOptimizer:
                  f"({self.layout.total * 12 / 2**30:.2f} GiB opt state)")
 
     def init_from(self, params: Pytree) -> None:
+        """(Re)build the tier from params: fresh master, fresh moments.
+
+        Called both at engine init and on a cross-mode checkpoint restore
+        mid-process — the moments/step MUST be reset, or a restore after
+        earlier steps in the same process silently resumes with stale
+        Adam state."""
         self.master = self.layout.flatten_np(params)
+        if self.adam.exp_avg is not None:
+            self.adam.exp_avg.fill(0.0)
+        if self.adam.exp_avg_sq is not None:
+            self.adam.exp_avg_sq.fill(0.0)
+        self.adam.step_count = 0
 
     # ------------------------------------------------------------ flat path
     def _widen_grads(self, flat_g: np.ndarray) -> np.ndarray:
