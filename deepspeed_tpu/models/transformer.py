@@ -422,8 +422,12 @@ def resolve_remat_policy(name: Optional[str]):
         # bf16); backward recomputes the cheap-to-recompute MLP/projection
         # GEMMs but NOT attention — the best memory/time trade when
         # attention is bandwidth-bound
+        # "moe_dispatch" rides along in every save_* policy: the MoE
+        # counting-sort metadata (parallel/moe.py) is ~0.4MB/layer but
+        # recomputing it in backward re-runs the dispatch histogram
         "save_attn_out":
-            jax.checkpoint_policies.save_only_these_names("attn_out"),
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "moe_dispatch", "moe_xs"),
         # save the Pallas flash kernel's residuals (pre-projection out +
         # lse, named inside the custom_vjp fwd) instead of the projected
         # attn_out: same bytes (+~1% for lse), but the backward no longer
@@ -432,16 +436,17 @@ def resolve_remat_policy(name: Optional[str]):
         # projection recomputes. Pallas-attention configs only (other
         # impls don't emit these names and would save nothing).
         "save_attn_kernel":
-            jax.checkpoint_policies.save_only_these_names("attn_kernel_out",
-                                                          "attn_lse"),
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_kernel_out", "attn_lse", "moe_dispatch",
+                "moe_xs"),
         # also save post-rope q/k/v: backward skips the QKV projection
         # recompute at +(q_dim+2·kv·Dh)·2B per token of HBM. Helps only
         # when HBM is loose — at the 1.27B/seq2048/b8 bench point the
         # extra residency evicts the CE chunk budget and LOSES 20+ MFU
         # points; measure before enabling
         "save_attn_qkv":
-            jax.checkpoint_policies.save_only_these_names("attn_out",
-                                                          "qkv"),
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "qkv", "moe_dispatch", "moe_xs"),
         # Host-DRAM activation offload — the reference's cpu_checkpointing
         # (runtime/activation_checkpointing/checkpointing.py partition/
         # cpu_checkpoint knobs). XLA emits async copy-start/copy-done pairs
@@ -453,17 +458,17 @@ def resolve_remat_policy(name: Optional[str]):
         # (max HBM savings — the cpu_checkpointing analogue proper).
         "offload_attn_out":
             jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
+                names_which_can_be_saved=["moe_dispatch"],
                 names_which_can_be_offloaded=["attn_out"],
                 offload_src="device", offload_dst="pinned_host"),
         "offload_attn_qkv":
             jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
+                names_which_can_be_saved=["moe_dispatch"],
                 names_which_can_be_offloaded=["attn_out", "qkv"],
                 offload_src="device", offload_dst="pinned_host"),
         "offload_full":
             jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
+                names_which_can_be_saved=["moe_dispatch"],
                 names_which_can_be_offloaded=["block_in"],
                 offload_src="device", offload_dst="pinned_host"),
         # block_in to host + attn_out kept in HBM: backward skips the
@@ -472,7 +477,7 @@ def resolve_remat_policy(name: Optional[str]):
         # spot when save_attn_out alone no longer fits
         "offload_save_attn_out":
             jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=["attn_out"],
+                names_which_can_be_saved=["attn_out", "moe_dispatch"],
                 names_which_can_be_offloaded=["block_in"],
                 offload_src="device", offload_dst="pinned_host"),
         # flash-kernel residuals kept in HBM (backward skips the flash
@@ -481,7 +486,8 @@ def resolve_remat_policy(name: Optional[str]):
         # residual chain and the kernel outputs on device OOMs
         "offload_save_attn_kernel":
             jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=["attn_kernel_out", "attn_lse"],
+                names_which_can_be_saved=["attn_kernel_out", "attn_lse",
+                                           "moe_dispatch"],
                 names_which_can_be_offloaded=["block_in"],
                 offload_src="device", offload_dst="pinned_host"),
     }

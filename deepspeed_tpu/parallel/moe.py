@@ -132,6 +132,19 @@ def _shared_expert(sh, xf: jax.Array) -> jax.Array:
     return s_out
 
 
+def _use_pallas_gmm(d: int, f: int) -> bool:
+    """Kernel selection for the dropless FFN: DSTPU_MOE_KERNEL ∈
+    auto (default: Pallas on TPU when shapes tile) | pallas | xla."""
+    import os
+    from deepspeed_tpu.ops import grouped_matmul as gmm
+    mode = os.environ.get("DSTPU_MOE_KERNEL", "auto")
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    return jax.default_backend() == "tpu" and gmm.supported(d, f)
+
+
 def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
                   top_k: int) -> jax.Array:
     """Token-local dropless dispatch: sort + grouped matmul + combine.
@@ -139,23 +152,57 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
     xf [S,d], topv/topi [S,k] → out [S,d]. Every op is per-token local
     (no collectives), so this body runs unchanged either globally or as
     the per-shard body of a shard_map over the batch axes.
+
+    Two grouped-matmul backends (ops/grouped_matmul.py docstring has the
+    design): the Pallas suite (block-aligned counting-sort dispatch +
+    fused GLU kernels — the r4 decomposition's "grouped matmul with
+    fused dispatch" lever) on TPU, and the original argsort +
+    ``lax.ragged_dot`` path elsewhere / via DSTPU_MOE_KERNEL=xla.
     """
     s, d = xf.shape
     e = p["wg"].shape[0]
-    # stable sort of the S*k (token, slot) assignments by expert id
-    flat_e = topi.reshape(-1)                                 # [S*k]
-    order = jnp.argsort(flat_e, stable=True)                  # [S*k]
-    tok = order // top_k                                      # source token
-    xs = xf[tok]                                              # [S*k, d]
-    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    f = p["wg"].shape[-1]
+    if _use_pallas_gmm(d, f):
+        from jax.ad_checkpoint import checkpoint_name
+        from deepspeed_tpu.ops import grouped_matmul as gmm
+        bm, bnf, bnd = gmm.pick_blocks(d, f, xf.dtype.itemsize)
+        # the counting-sort metadata is tiny (~0.4MB/layer) but its
+        # recompute under remat is not (cumsum histogram + int scatters
+        # re-run in backward) — name it so the save_* policies keep it
+        # cast combine weights to compute dtype BEFORE the dispatch
+        # scatter: values are identical to casting after the gather (a
+        # scatter moves bits), but the scatter payload halves
+        tok, w, g_of_tile, sizes, pos = checkpoint_name(
+            gmm.aligned_dispatch(topi, topv.astype(xf.dtype), e, bm),
+            "moe_dispatch")
+        xf1 = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
+        # the sorted-gather is a random-row HBM access pattern — save it
+        # (bf16 [R_pad, d], ~74MB/layer at the 16K-token bench) so the
+        # remat backward does not re-run it
+        xs = checkpoint_name(gmm.gather_rows(xf1, tok, pos), "moe_xs")
+        y = gmm.grouped_glu_ffn(
+            xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
+            p["wo"].astype(xs.dtype), g_of_tile, sizes,
+            bm=bm, bnf=bnf, bnd=bnd,
+            interpret=jax.default_backend() != "tpu")
+        # combine = gather over the inverse map (no token scatter-add)
+        out = gmm.gather_combine(y, w.astype(y.dtype), tok, pos)
+    else:
+        # stable sort of the S*k (token, slot) assignments by expert id
+        flat_e = topi.reshape(-1)                             # [S*k]
+        order = jnp.argsort(flat_e, stable=True)              # [S*k]
+        tok = order // top_k                                  # source token
+        xs = xf[tok]                                          # [S*k, d]
+        group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
 
-    gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
-    up_b = lax.ragged_dot(xs, p["wi"].astype(xs.dtype), group_sizes)
-    hidden = jax.nn.silu(gate_b) * up_b
-    out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype), group_sizes)
+        gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
+        up_b = lax.ragged_dot(xs, p["wi"].astype(xs.dtype), group_sizes)
+        hidden = jax.nn.silu(gate_b) * up_b
+        out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype),
+                               group_sizes)
 
-    w = topv.reshape(-1)[order].astype(xf.dtype)              # [S*k]
-    out = jnp.zeros((s, d), xf.dtype).at[tok].add(out_s * w[:, None])
+        w = topv.reshape(-1)[order].astype(xf.dtype)          # [S*k]
+        out = jnp.zeros((s, d), xf.dtype).at[tok].add(out_s * w[:, None])
 
     if "shared" in p:   # dense shared expert, same as the capacity path
         out = out + _shared_expert(p["shared"], xf)
