@@ -88,11 +88,11 @@ def _suite(root):
         [bench, "--mode", "moe", "--steps", "24"], 480))
     for q in ("int8", "int4"):
         r = _run_sub([os.path.join(root, "bench_inference.py"),
-                      "--quant", q, "--n-prompts", "12",
-                      "--new-tokens", "48"], 560)
+                      "--quant", q], 560)
         suite[f"serving_{q}"] = (
             {"ragged_tok_s": r["extra"]["ragged_tok_s"],
-             "vs_padded": r["extra"]["speedup"]}
+             "vs_padded": r["extra"]["speedup"],
+             "uniform_gen": r["extra"]["uniform_gen"]}
             if "extra" in r else r)
     return suite
 

@@ -528,11 +528,157 @@ class RaggedInferenceEngineTPU:
         """jit: up to `sb` single-token decode iterations in ONE device
         program — the per-token host round-trips of the stepwise loop
         (2+ per token; ~20 ms each on tunneled runtimes) collapse to one
-        upload + one [sb, nb] fetch. The page table is FIXED for the
-        whole loop (pages pre-allocated by the caller), tokens feed back
-        on device via lax.scan; `limit` (traced) dead-masks iterations
-        past the requested step count."""
+        upload + one [sb, nb] fetch.
+
+        The arena stays OUT of the scan carry: new KV lands in a small
+        per-loop decode buffer ([L, sb, nb, kvh, dh] — a few MB) and each
+        step's attention = merge(history over the READ-ONLY arena,
+        causal attention over the buffer so far) by logsumexp. The
+        buffer is written back into the arena pages in one pass after
+        the loop. Carrying the arena instead forces XLA to copy it every
+        iteration (two ~33MB copies per layer-step profiled on v5e), and
+        a read-only arena also lets the Pallas paged kernel serve the
+        history part — it walks only each sequence's true pages, where
+        the XLA gather path fetches the padded page-table width.
+        `limit` (traced) dead-masks iterations past the requested step
+        count; their buffer rows are clipped by the write-back counts."""
         key = (nb, sb, mode)
+        if key in self._fused_fns:
+            return self._fused_fns[key]
+        if os.environ.get("DSTPU_FUSED_V1"):
+            return self._fused_decode_fn_v1(nb, sb, mode)
+        model = self.model_config
+        from deepspeed_tpu.ops.paged_attention import _masked_attention
+
+        num_layers = model.num_layers
+        kvh, dh = model.kv_heads, model.head_dim
+
+        def fn(params, arena, tokens0, starts0, live, pt, limit, temp,
+               top_p, rng):
+            stride = arena["k"].shape[1] // num_layers
+            ak_c, av_c = arena["k"], arena["v"]       # read-only in loop
+            kbuf0 = jnp.zeros((num_layers, sb, nb, kvh, dh), self.dtype)
+            vbuf0 = jnp.zeros_like(kbuf0)
+
+            def step(carry, i):
+                tokens, rng, kbuf, vbuf = carry
+                # no in-step dead-masking needed: iterations past
+                # `limit` produce garbage the write-back clips
+                # (counts_wb) and the host slices away
+                positions = (starts0 + i)[:, None]            # [nb, 1]
+                x = embed_tokens(
+                    model, params["embed"], tokens[:, None],
+                    jnp.minimum(positions,
+                                params["embed"]["pos"].shape[0] - 1)
+                    if model.pos_emb == "learned" else positions,
+                    params.get("embed_norm"))
+                if model.pos_emb == "rope":
+                    sin, cos = rope_table(model, positions)
+                else:
+                    sin = cos = jnp.zeros((nb, 1, 0), x.dtype)
+
+                jdx = jnp.arange(sb, dtype=jnp.int32)
+                dec_mask = (jdx[None, :] <= i)[None, None, None]
+
+                def layer_body(carry_l, layer):
+                    xl, kbuf, vbuf = carry_l
+                    lp, l_idx = layer
+                    pt_l = pt + l_idx * stride
+                    h_in = _norm(model, lp["ln1"], xl)
+                    q, k, v = qkv_project(model, lp["attn"], h_in, sin,
+                                          cos)
+                    # history: keys [0, starts0) straight from the
+                    # arena. XLA gather-attend by default: the Pallas
+                    # kernel's (seq, head) grid is launch-overhead-bound
+                    # at decode widths (268 vs 70 us/layer-step profiled
+                    # at n=16 on v5e); opt in via DSTPU_FUSED_PALLAS_HIST
+                    # for wide-batch/long-context serving where walking
+                    # only the true pages wins back the gather padding
+                    if self.use_pallas and \
+                            os.environ.get("DSTPU_FUSED_PALLAS_HIST"):
+                        out_h, lse_h = pa.paged_attention_with_lse(
+                            q, ak_c, av_c, pt_l, starts0,
+                            jnp.zeros_like(starts0))
+                    else:
+                        out_h, lse_h = pa.paged_attention_hist_xla(
+                            q, ak_c, av_c, pt_l, starts0)
+                    # decode window: this loop's own tokens (incl. self)
+                    kbuf = lax.dynamic_update_slice(
+                        kbuf, k[:, 0][None, None].astype(kbuf.dtype),
+                        (l_idx, i, 0, 0, 0))
+                    vbuf = lax.dynamic_update_slice(
+                        vbuf, v[:, 0][None, None].astype(vbuf.dtype),
+                        (l_idx, i, 0, 0, 0))
+                    kd = lax.dynamic_index_in_dim(
+                        kbuf, l_idx, 0, keepdims=False)       # [sb,nb,..]
+                    vd = lax.dynamic_index_in_dim(vbuf, l_idx, 0,
+                                                  keepdims=False)
+                    out_d, lse_d = _masked_attention(
+                        q, kd.transpose(1, 2, 0, 3),
+                        vd.transpose(1, 2, 0, 3), dec_mask, True)
+                    out = pa.merge_attention(out_h, lse_h, out_d,
+                                             lse_d).astype(q.dtype)
+                    attn_out = attn_out_project(model, lp["attn"], out)
+                    h_out, _aux = block_combine(model, lp, xl, h_in,
+                                                attn_out, self._moe_fn)
+                    return (h_out, kbuf, vbuf), None
+
+                if os.environ.get("DSTPU_FUSED_SCAN_LAYERS"):
+                    (x, kbuf, vbuf), _ = lax.scan(
+                        layer_body, (x, kbuf, vbuf),
+                        (params["layers"],
+                         jnp.arange(num_layers, dtype=jnp.int32)))
+                else:
+                    # UNROLLED layer loop: under lax.scan every layer's
+                    # (packed) weights are dynamic-sliced out of the
+                    # stacked params into fresh buffers each step —
+                    # pure copy traffic that roughly doubles the
+                    # weight-bound decode cost. Unrolling lets XLA feed
+                    # the kernels from the stacked arrays directly;
+                    # compile time stays modest because the decode
+                    # graph is small.
+                    carry_l = (x, kbuf, vbuf)
+                    for l in range(num_layers):
+                        lp = jax.tree.map(lambda a: a[l],
+                                          params["layers"])
+                        carry_l, _ = layer_body(
+                            carry_l, (lp, jnp.int32(l)))
+                    x, kbuf, vbuf = carry_l
+                x = _norm(model, params["final_norm"], x)
+                logits = lm_logits(model, params, x)[:, 0]
+                nxt, rng = _sample_tokens(logits, mode, temp, top_p, rng)
+                return (nxt, rng, kbuf, vbuf), nxt
+
+            (_, rng, kbuf, vbuf), ys = lax.scan(
+                step, (tokens0, rng, kbuf0, vbuf0),
+                jnp.arange(sb, dtype=jnp.int32))
+
+            # one write-back pass: buffer rows [0, limit) per live row
+            counts_wb = live * limit
+
+            def wb(carry, inp):
+                ak, av = carry
+                kb, vb, l_idx = inp                  # kb [sb, nb, kvh, dh]
+                pt_l = pt + l_idx * stride
+                ak, av = pa.write_kv(
+                    ak, av, kb.transpose(1, 0, 2, 3),
+                    vb.transpose(1, 0, 2, 3), pt_l, starts0, counts_wb,
+                    trash_block=l_idx * stride + stride - 1)
+                return (ak, av), None
+
+            (ak, av), _ = lax.scan(
+                wb, (arena["k"], arena["v"]),
+                (kbuf, vbuf, jnp.arange(num_layers, dtype=jnp.int32)))
+            return ys, rng, {"k": ak, "v": av}
+
+        jitted = jax.jit(fn, donate_argnums=(1,))
+        self._fused_fns[key] = jitted
+        return jitted
+
+    def _fused_decode_fn_v1(self, nb: int, sb: int, mode):
+        """The r4 arena-carrying loop (XLA attend, arena copied per
+        iteration) — kept for A/B via DSTPU_FUSED_V1."""
+        key = (nb, sb, mode, "v1")
         if key in self._fused_fns:
             return self._fused_fns[key]
         model = self.model_config
@@ -542,11 +688,6 @@ class RaggedInferenceEngineTPU:
             def body(carry, i):
                 tokens, starts, arena, rng = carry
                 live_i = live * (i < limit).astype(jnp.int32)
-                # XLA attend here, NOT the Pallas kernel: inside the scan
-                # the pallas_call defeats carry aliasing and the 2.7 GB
-                # arena is materialized every iteration (measured 109 ms/
-                # step vs 6.6 ms with the XLA gather path on v5e); the
-                # Pallas kernel keeps serving the stepwise/streaming path
                 logits, arena = ragged_forward(
                     model, params, arena, tokens[:, None], live_i, starts,
                     pt, use_pallas=False, moe_fn=self._moe_fn)
@@ -600,6 +741,13 @@ class RaggedInferenceEngineTPU:
         pt = self._page_table(uids, nb)
         for i, u in enumerate(uids):
             starts0[i] = len(self.state.seqs[u].tokens)
+        # slice the page table to the pages this batch can actually
+        # touch (bucketed to limit recompiles): the history gather
+        # fetches mb*block_size keys per row, and the full max_seq_len
+        # table width costs ~2x the true KV traffic on typical mixes
+        mb_need = int(-(-(int(starts0.max()) + steps) // bs))
+        mb_b = min(self.mb, -(-mb_need // 4) * 4)
+        pt = pt[:, :mb_b]
         ys, self._rng_dev, self.arena = self._fused_decode_fn(
             nb, sb, mode)(
                 self.params, self.arena, jnp.asarray(tokens0),
@@ -610,15 +758,185 @@ class RaggedInferenceEngineTPU:
 
     # -- convenience serving loop ------------------------------------------
 
-    def generate(self, prompts, max_new_tokens: int = 64,
+    def _consume_first(self, u: int, t: int, seqs, remaining, cur_tok,
+                       active: List[int], eos_token_id) -> None:
+        """Shared post-sample bookkeeping: append token t to sequence u,
+        spend budget, retire (flush) on exhaustion/eos, else keep u
+        active with t as the next fed token."""
+        seqs[u].append(t)
+        remaining[u] -= 1
+        if remaining[u] <= 0 or (eos_token_id is not None
+                                 and t == eos_token_id):
+            self.flush(u)
+        else:
+            active.append(u)
+            cur_tok[u] = t
+
+    def _validate_lengths(self, prompts, budget_list, caller: str) -> None:
+        """Fail BEFORE any compute when a request cannot fit max_seq_len
+        even in principle — the chunked loop would otherwise burn most
+        of the workload and then discard every sequence's output."""
+        for i, (p, m) in enumerate(zip(prompts, budget_list)):
+            total = len(np.asarray(p).reshape(-1)) + max(0, m)
+            if total > self.config.max_seq_len:
+                raise ValueError(
+                    f"{caller}(): request {i} would reach {total} tokens,"
+                    f" over max_seq_len={self.config.max_seq_len}; lower "
+                    f"max_new_tokens or raise max_seq_len")
+
+    def _run_fused_chunk(self, active: List[int], cur_tok: Dict[int, int],
+                         remaining: Dict[int, int],
+                         seqs: Dict[int, list], eos_token_id, mode):
+        """One device-resident decode chunk over ``active`` rows:
+        decode, consume, retire finished sequences (flush). Mutates
+        cur_tok/remaining/seqs; returns (still_active, None) or
+        (active, exc) when the fused path is unavailable."""
+        chunk = min(self._FUSED_STEP_BUCKET,
+                    max(remaining[u] for u in active))
+        try:
+            tok_mat = self._fused_decode(
+                active, [cur_tok[u] for u in active], chunk, mode)
+        except FusedDecodeUnavailable as e:
+            return active, e
+        still: List[int] = []
+        for j, u in enumerate(active):
+            take = min(chunk, remaining[u])
+            done = remaining[u] <= chunk
+            fed = cur_tok[u]
+            for s_i in range(take):
+                t = int(tok_mat[s_i, j])
+                seqs[u].append(t)
+                remaining[u] -= 1
+                if eos_token_id is not None and t == eos_token_id:
+                    done = True
+                    break
+            if done:
+                self.flush(u)
+            else:
+                # the chunk's KV is already in the arena (pages
+                # pre-allocated by _fused_decode): advance the host
+                # descriptor to match — the tokens whose KV landed are
+                # the fed token plus all but the last sampled one,
+                # which seeds the next chunk
+                seq = self.state.seqs[u]
+                seq.tokens.extend([fed] + [int(t) for t in
+                                           tok_mat[:chunk - 1, j]])
+                seq.seen_tokens = len(seq.tokens)
+                still.append(u)
+                cur_tok[u] = int(tok_mat[chunk - 1, j])
+        return still, None
+
+    def serve(self, prompts, max_new_tokens: Union[int, List[int]] = 64,
+              max_concurrency: int = 16,
+              eos_token_id: Optional[int] = None,
+              temperature: float = 0.0, top_k: int = 0,
+              top_p: float = 1.0) -> List[np.ndarray]:
+        """Continuous-batching SERVER loop over a request stream.
+
+        Processes ``prompts`` (any number) with at most
+        ``max_concurrency`` sequences resident: queued requests are
+        admitted the moment a slot frees, so the decode batch stays full
+        while long-tail requests run out their budgets. This is the
+        workload shape behind the reference FastGen throughput claim
+        (blogs/deepspeed-fastgen: 2.3x effective throughput) — a padded
+        static engine must run each batch to ITS longest request and
+        only then start the next batch. Returns full sequences in input
+        order.
+        """
+        from collections import deque
+        if temperature == 0.0:
+            mode = ("argmax",)
+        else:
+            mode = ("sample", int(top_k), top_p < 1.0)
+            self._temperature = float(temperature)
+            self._top_p = float(top_p)
+        n = len(prompts)
+        if isinstance(max_new_tokens, (int, np.integer)):
+            budget_list = [int(max_new_tokens)] * n
+        else:
+            if len(max_new_tokens) != n:
+                raise ValueError("per-sequence max_new_tokens must match "
+                                 "the number of prompts")
+            budget_list = [int(m) for m in max_new_tokens]
+        self._validate_lengths(prompts, budget_list, "serve")
+        base = max(self.state.seqs.keys(), default=-1) + 1
+        # zero-budget requests pass through untouched
+        queue = deque(i for i in range(n) if budget_list[i] > 0)
+        seqs: Dict[int, list] = {
+            base + i: list(np.asarray(prompts[i]).reshape(-1)
+                           .astype(np.int32)) for i in range(n)}
+        remaining: Dict[int, int] = {}
+        cur_tok: Dict[int, int] = {}
+        active: List[int] = []
+        try:
+            while queue or active:
+                admit: List[int] = []
+                while queue and len(active) + len(admit) < max_concurrency:
+                    i = queue[0]
+                    # admission is capacity-gated so one oversized
+                    # request can't abort the stream mid-flight; it
+                    # waits for retirements to free pages instead
+                    if not self.state.can_schedule(len(seqs[base + i])):
+                        break
+                    queue.popleft()
+                    u = base + i
+                    remaining[u] = budget_list[i]
+                    admit.append(u)
+                if queue and not admit and not active:
+                    i = queue[0]
+                    raise ValueError(
+                        f"serve(): request {i} ({len(seqs[base + i])} "
+                        f"tokens) cannot be scheduled even on an empty "
+                        f"engine; raise num_blocks/max_sequences")
+                if admit:
+                    pending = self._put_tokens(
+                        admit, [seqs[u] for u in admit], mode)
+                    for u in admit:
+                        self._consume_first(u, pending[u], seqs,
+                                            remaining, cur_tok, active,
+                                            eos_token_id)
+                if not active:
+                    continue
+                if os.environ.get("DSTPU_NO_FUSED_DECODE"):
+                    err: Optional[Exception] = FusedDecodeUnavailable(
+                        "disabled")
+                else:
+                    active, err = self._run_fused_chunk(
+                        active, cur_tok, remaining, seqs, eos_token_id,
+                        mode)
+                if err is not None:
+                    # stepwise fallback for one token per active row,
+                    # then re-enter the loop (slots may free / arena
+                    # pressure may ease)
+                    pending = self._put_tokens(
+                        active, [[cur_tok[u]] for u in active], mode)
+                    still: List[int] = []
+                    for u in active:
+                        self._consume_first(u, pending[u], seqs,
+                                            remaining, cur_tok, still,
+                                            eos_token_id)
+                    active = still
+        except Exception:
+            for u in list(self.state.seqs):
+                if u >= base:
+                    self.flush(u)
+            raise
+        return [np.asarray(seqs[base + i], np.int32) for i in range(n)]
+
+    def generate(self, prompts, max_new_tokens: Union[int, List[int]] = 64,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0) -> List[np.ndarray]:
         """Continuous-batching generation (greedy by default; temperature/
         top-k/top-p sampled on device). ``prompts`` is a list of 1-D int
-        arrays (ragged lengths). Returns the full token sequences.
-        Sequences join/leave the batch independently — the continuous
-        batching the padded v1 engine can't do."""
+        arrays (ragged lengths); ``max_new_tokens`` may be per-sequence.
+        Returns the full token sequences. Sequences join/leave the batch
+        independently — the continuous batching the padded v1 engine
+        can't do: the fused decode runs in device-resident CHUNKS and
+        finished sequences RETIRE between chunks (budget exhausted or
+        eos), so a long-tail generation mix only pays for the tokens it
+        actually produces, while a padded static batch computes every
+        row out to the longest request."""
         if temperature == 0.0:
             mode = ("argmax",)
         else:
@@ -630,48 +948,71 @@ class RaggedInferenceEngineTPU:
         # put([0], ...) silently extended sequence 0)
         base = max(self.state.seqs.keys(), default=-1) + 1
         uids = [base + i for i in range(len(prompts))]
+        if isinstance(max_new_tokens, (int, np.integer)):
+            budgets = {u: int(max_new_tokens) for u in uids}
+        else:
+            if len(max_new_tokens) != len(prompts):
+                raise ValueError("per-sequence max_new_tokens must match "
+                                 "the number of prompts")
+            budgets = {u: int(m) for u, m in zip(uids, max_new_tokens)}
+        if eos_token_id is None:
+            # without eos there is no early exit: a request that cannot
+            # fit max_seq_len must fail BEFORE any compute, not after
+            # the chunked loop has burned most of the workload
+            self._validate_lengths(prompts, [budgets[u] for u in uids],
+                                   "generate")
         seqs = {u: list(np.asarray(p).reshape(-1).astype(np.int32))
                 for u, p in zip(uids, prompts)}
-        remaining = {u: max_new_tokens for u in uids}
+        remaining = dict(budgets)
         pending = self._put_tokens(uids, [seqs[u] for u in uids], mode)
-        # fast path: every sequence is now in pure decode — run the whole
-        # loop on device (one fetch) instead of 2+ round-trips per token.
-        # With eos_token_id the loop still runs `steps` iterations and the
-        # outputs are truncated on host (bounded wasted compute, traded
-        # for the removed per-token latency); DSTPU_NO_FUSED_DECODE
-        # restores the stepwise loop.
-        steps = max_new_tokens - 1
-        if steps > 0 and uids and len(pending) == len(uids) \
+        # fast path: every sequence is now in pure decode — run
+        # device-resident chunks (one upload + one fetch per chunk
+        # instead of 2+ round-trips per token), retiring finished rows
+        # between chunks. DSTPU_NO_FUSED_DECODE restores the stepwise
+        # loop.
+        if uids and len(pending) == len(uids) \
+                and max(remaining.values(), default=0) > 1 \
                 and not os.environ.get("DSTPU_NO_FUSED_DECODE"):
-            try:
-                tok_mat = self._fused_decode(
-                    uids, [pending[u] for u in uids], steps, mode)
-            except FusedDecodeUnavailable as e:
-                if e.doomed and eos_token_id is None:
-                    # the stepwise loop would hit the same wall mid-
-                    # generation, after burning steps and LEAKING the
-                    # sequences' pages — fail cleanly up front instead
-                    for u in uids:
+            active: List[int] = []
+            cur_tok: Dict[int, int] = {}
+            for u in uids:
+                self._consume_first(u, pending[u], seqs, remaining,
+                                    cur_tok, active, eos_token_id)
+            fused_failed = False
+            while active and not fused_failed:
+                active, err = self._run_fused_chunk(
+                    active, cur_tok, remaining, seqs, eos_token_id, mode)
+                if err is not None:
+                    if err.doomed and eos_token_id is None:
+                        # the stepwise loop would hit the same wall mid-
+                        # generation, after burning steps and LEAKING
+                        # the sequences' pages — fail cleanly up front
+                        for u in uids:
+                            if u in self.state.seqs:
+                                self.flush(u)
+                        raise ValueError(
+                            f"generate(): {err}; lower max_new_tokens or "
+                            f"raise max_seq_len") from err
+                    log_dist(f"fused decode unavailable ({err}); using "
+                             f"the stepwise loop")
+                    fused_failed = True
+            if not fused_failed:
+                for u in uids:
+                    if u in self.state.seqs:
                         self.flush(u)
-                    raise ValueError(
-                        f"generate(): {e}; lower max_new_tokens or raise "
-                        f"max_seq_len") from e
-                log_dist(f"fused decode unavailable ({e}); using the "
-                         f"stepwise loop")
-            else:
-                for j, u in enumerate(uids):
-                    seqs[u].append(pending[u])
-                    if eos_token_id is not None \
-                            and pending[u] == eos_token_id:
-                        self.flush(u)
-                        continue
-                    for s_i in range(steps):
-                        t = int(tok_mat[s_i, j])
-                        seqs[u].append(t)
-                        if eos_token_id is not None and t == eos_token_id:
-                            break
-                    self.flush(u)
                 return [np.asarray(seqs[u], np.int32) for u in uids]
+            # stepwise continuation from the current chunked state: the
+            # rows still active have their last sampled token NOT yet
+            # fed — exactly the `pending` shape the loop below consumes.
+            # (The first-token appends already happened above, so hand
+            # the loop a pending map of the still-unfed tokens only.)
+            pending = {u: cur_tok[u] for u in active}
+            # the loop's first action is to append pending tokens; ours
+            # are already appended — drop them from seqs to avoid the
+            # double-append, keeping remaining consistent
+            for u in active:
+                seqs[u].pop()
+                remaining[u] += 1
         try:
             while pending:
                 active_uids, toks = [], []

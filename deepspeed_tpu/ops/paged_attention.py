@@ -209,8 +209,9 @@ def causal_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array):
 # ---------------------------------------------------------------------------
 
 def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_hbm, v_hbm,
-                  o_ref, k_buf, v_buf, sem_k, sem_v, *, block_size: int,
-                  chunk: int, scale: float, mb: int):
+                  o_ref, *rest, block_size: int,
+                  chunk: int, scale: float, mb: int,
+                  with_lse: bool = False):
     """Grid (n_seq, kvh): ONE program per (sequence, kv head) that walks
     this sequence's pages with double-buffered manual DMAs from the
     HBM-resident arena.
@@ -223,8 +224,14 @@ def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_hbm, v_hbm,
 
     q_ref block: [1, 1, rows, dh] (row = g*chunk + j); k_hbm/v_hbm: the
     FULL arena [kvh, NB, bs, dh] left in ANY/HBM memory space; k_buf/
-    v_buf: [2, bs, dh] VMEM double buffers.
+    v_buf: [2, bs, dh] VMEM double buffers. With ``with_lse`` an extra
+    [1, 1, rows] f32 output carries each row's logsumexp (the
+    partial-attention merge needs it — fused decode's history part).
     """
+    if with_lse:
+        lse_ref, k_buf, v_buf, sem_k, sem_v = rest
+    else:
+        k_buf, v_buf, sem_k, sem_v = rest
     s_idx = pl.program_id(0)
     kh = pl.program_id(1)
     rows = q_ref.shape[2]
@@ -288,10 +295,15 @@ def _paged_kernel(pt_ref, starts_ref, counts_ref, q_ref, k_hbm, v_hbm,
         acc, m, l = lax.fori_loop(0, npages, body, (acc0, m0, l0))
         l = jnp.maximum(l, 1e-30)
         o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+        if with_lse:
+            lse_ref[0, 0] = jnp.where(m > _NEG_INF / 2, m + jnp.log(l),
+                                      _NEG_INF)[:, None]
 
     @pl.when(npages == 0)
     def _empty():
         o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+        if with_lse:
+            lse_ref[0, 0] = jnp.full_like(lse_ref[0, 0], _NEG_INF)
 
 
 def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
@@ -347,6 +359,64 @@ def paged_attention(q: jax.Array, arena_k: jax.Array, arena_v: jax.Array,
     # [n, kvh, g*c, dh] → [n, c, h, dh]
     return out.reshape(n, kvh, groups, c, dh).transpose(0, 3, 1, 2, 4) \
         .reshape(n, c, h, dh)
+
+
+def paged_attention_with_lse(q: jax.Array, arena_k: jax.Array,
+                             arena_v: jax.Array, page_table: jax.Array,
+                             starts: jax.Array, counts: jax.Array, *,
+                             interpret: bool = False):
+    """Pallas paged attention returning (out, lse [n, c, h] fp32) for the
+    partial-attention merge. ``counts=0`` gives HISTORY-only semantics
+    (keys [0, starts)) — the fused decode loop's arena part, where the
+    arena is a read-only input rather than a carried/donated buffer."""
+    kvh, nbp1, bs, dh = arena_k.shape
+    n, c, h, _ = q.shape
+    groups = h // kvh
+    mb = page_table.shape[1]
+    rows = groups * c
+
+    qk = q.reshape(n, c, kvh, groups, dh).transpose(0, 2, 3, 1, 4) \
+        .reshape(n, kvh, rows, dh)
+
+    grid = (n, kvh)
+    kernel = functools.partial(_paged_kernel, block_size=bs, chunk=c,
+                               scale=1.0 / math.sqrt(dh), mb=mb,
+                               with_lse=True)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, dh),
+                             lambda s, kh, pt, st, ct: (s, kh, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, rows, dh),
+                             lambda s, kh, pt, st, ct: (s, kh, 0, 0)),
+                pl.BlockSpec((1, 1, rows, 1),
+                             lambda s, kh, pt, st, ct: (s, kh, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, bs, dh), arena_k.dtype),
+                pltpu.VMEM((2, bs, dh), arena_v.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((n, kvh, rows, dh), q.dtype),
+                   jax.ShapeDtypeStruct((n, kvh, rows, 1), jnp.float32)],
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), starts.astype(jnp.int32),
+      counts.astype(jnp.int32), qk, arena_k, arena_v)
+
+    out = out.reshape(n, kvh, groups, c, dh).transpose(0, 3, 1, 2, 4) \
+        .reshape(n, c, h, dh)
+    lse = lse.reshape(n, kvh, groups, c).transpose(0, 3, 1, 2) \
+        .reshape(n, c, h)
+    return out, lse
 
 
 def supported(head_dim: int, block_size: int) -> bool:

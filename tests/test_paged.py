@@ -396,3 +396,96 @@ def test_flash_attention_with_lse_matches_xla(devices):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_retirement_per_seq_budgets(devices, monkeypatch):
+    """Per-sequence max_new_tokens with chunk-boundary retirement must
+    produce token-for-token the same output as solo dense generation —
+    across MULTIPLE fused chunks (budgets straddle the 32-step chunk
+    bucket) and with retired rows leaving the batch mid-generation."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=256, vocab_size=256)
+    from deepspeed_tpu.models.transformer import init_params
+    params = init_params(cfg, jax.random.PRNGKey(3))
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, size=(n,), dtype=np.int32)
+               for n in (5, 11, 23, 17)]
+    budgets = [3, 40, 70, 33]     # straddle chunk boundaries + early out
+
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 96, "block_size": 16,
+              "max_seq_len": 128, "prefill_chunk": 8,
+              "max_batch_tokens": 64},
+        params=params)
+    outs = v2.generate(prompts, max_new_tokens=budgets)
+
+    v1 = init_inference(cfg, {"dtype": "float32"}, params=params)
+    for p, m, got in zip(prompts, budgets, outs):
+        assert len(got) == len(p) + m
+        ref = v1.generate(p[None, :], max_new_tokens=m)[0]
+        np.testing.assert_array_equal(got, ref[:len(p) + m])
+
+    # all pages released after generate
+    assert len(v2.state.seqs) == 0
+
+    # the stepwise path agrees too (fused disabled)
+    monkeypatch.setenv("DSTPU_NO_FUSED_DECODE", "1")
+    outs2 = v2.generate(prompts, max_new_tokens=budgets)
+    for a, b in zip(outs, outs2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_serve_stream_matches_solo(devices):
+    """serve(): a request stream at max_concurrency < n must produce
+    token-for-token solo-engine outputs, admit queued requests as slots
+    free, and release every page at the end."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=256, vocab_size=256)
+    from deepspeed_tpu.models.transformer import init_params
+    params = init_params(cfg, jax.random.PRNGKey(5))
+
+    rng = np.random.default_rng(9)
+    n = 10
+    prompts = [rng.integers(0, 256, size=(int(l),), dtype=np.int32)
+               for l in rng.integers(4, 24, size=n)]
+    budgets = [int(b) for b in rng.integers(2, 40, size=n)]
+
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 64, "block_size": 16,
+              "max_seq_len": 128, "prefill_chunk": 8,
+              "max_batch_tokens": 64, "max_sequences": 8},
+        params=params)
+    outs = v2.serve(prompts, max_new_tokens=budgets, max_concurrency=4)
+
+    v1 = init_inference(cfg, {"dtype": "float32"}, params=params)
+    for p, m, got in zip(prompts, budgets, outs):
+        assert len(got) == len(p) + m
+        ref = v1.generate(p[None, :], max_new_tokens=m)[0]
+        np.testing.assert_array_equal(got, ref[:len(p) + m])
+    assert len(v2.state.seqs) == 0
+    assert v2.state.allocator.free_blocks == 64
+
+
+def test_serve_validation_and_zero_budget(devices):
+    """Oversized requests fail BEFORE any compute; zero-budget requests
+    pass through untouched."""
+    build_mesh(data=1, devices=jax.devices()[:1])
+    cfg = llama3_config("tiny", max_seq_len=128, vocab_size=256)
+    v2 = RaggedInferenceEngineTPU(
+        cfg, {"dtype": "float32", "num_blocks": 32, "block_size": 16,
+              "max_seq_len": 64, "prefill_chunk": 8,
+              "max_batch_tokens": 64})
+    rng = np.random.default_rng(1)
+    big = rng.integers(0, 256, size=(40,), dtype=np.int32)
+    with pytest.raises(ValueError, match="over max_seq_len"):
+        v2.serve([big], max_new_tokens=40)
+    with pytest.raises(ValueError, match="over max_seq_len"):
+        v2.generate([big], max_new_tokens=40)
+    assert len(v2.state.seqs) == 0
+
+    small = rng.integers(0, 256, size=(6,), dtype=np.int32)
+    outs = v2.serve([small, big], max_new_tokens=[4, 0])
+    assert len(outs[0]) == 10
+    np.testing.assert_array_equal(outs[1], big)   # untouched
+    assert len(v2.state.seqs) == 0
