@@ -84,6 +84,11 @@ def _suite(root):
         [bench, "--seq", "16384", "--batch", "1", "--steps", "10"], 480))
     suite["long_32k"] = mfu(_run_sub(
         [bench, "--seq", "32768", "--batch", "1", "--steps", "8"], 540))
+    # the FPDT regime (reference fpdt_layer.py:510): 128K tokens on ONE
+    # chip via host-offloaded block inputs + flash-kernel residuals and
+    # the sequence-chunked MLP
+    suite["long_128k"] = mfu(_run_sub(
+        [bench, "--seq", "131072", "--batch", "1", "--steps", "3"], 900))
     suite["moe_1b_8e_dropless"] = mfu(_run_sub(
         [bench, "--mode", "moe", "--steps", "24"], 480))
     for q in ("int8", "int4"):
@@ -230,13 +235,27 @@ def main() -> None:
         "activation_checkpointing": {
             "policy": os.environ.get(
                 "DSTPU_BENCH_REMAT",
-                ("offload_save_attn_kernel" if seq >= 32768
-                 else "save_attn_kernel") if on_tpu else "none")},
+                # at 256K+ attention runs via fpdt (no flash-kernel
+                # residual names to park) -> plain offload_full
+                ("offload_full" if seq >= 262144
+                 else "offload_save_attn_kernel_host" if seq >= 65536
+                 else "offload_save_attn_kernel" if seq >= 32768
+                 else "save_attn_kernel") if on_tpu else "none"),
+            # FPDT regime: at 64K+ the [T, ffn] MLP activations alone
+            # exceed HBM — run the MLP in sequence tiles
+            "ffn_chunk": int(os.environ.get(
+                "DSTPU_BENCH_FFN_CHUNK",
+                8192 if (on_tpu and seq >= 65536) else 0))},
         # bf16 chunk logits (fp32 accumulation kept) at a 256 MB budget:
         # the optimum is ~128-token chunks — in bf16 that is half the
         # bytes, so the budget halves with the dtype (+0.7 MFU vs fp32)
         "ce_logits_dtype": "bf16" if on_tpu else None,
         "chunked_ce_budget_mb": 256 if on_tpu else None,
+        # 256K+: even flash-kernel backward transients overflow HBM —
+        # FPDT chunked attention with host-resident KV
+        "attention_impl": os.environ.get(
+            "DSTPU_BENCH_ATTN",
+            "fpdt" if (on_tpu and seq >= 262144) else "auto"),
         "steps_per_print": 1000,
     }
     # DSTPU_BENCH_OFFLOAD=cpu|cpu_overlap|zenflow: measure the ZeRO-Offload

@@ -103,6 +103,9 @@ def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,
     act = 2 if dec_cfg.is_glu else 1   # silu_glu keeps 3·ffn recompute live
     per_layer_d = {
         "full": 1.0, "offload_full": 0.0,
+        # block_in AND the flash residuals parked on host: no per-layer
+        # device residency at all (the 128K+ policy)
+        "offload_save_attn_kernel_host": 0.0,
         "offload_attn_out": 1.0, "offload_attn_qkv": 1.0,
         "save_attn_out": 2.0, "save_attn_kernel": 2.0,
         "offload_save_attn_out": 1.0, "offload_save_attn_kernel": 1.0,
@@ -115,7 +118,12 @@ def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,
         "dots_with_no_batch_dims_saveable": 1.0,
     }.get(policy, 2.0)
     carry = L * B * T * d * p_bytes * per_layer_d
-    working = B * T * (4 * d + 3 * ffn) * p_bytes     # one block recompute
+    # one block recompute; the sequence-chunked MLP (ffn_chunk) caps the
+    # live [*, ffn] tiles at chunk tokens instead of the full T
+    ffn_chunk = int((config.get("activation_checkpointing", {}) or {})
+                    .get("ffn_chunk") or 0)
+    t_ffn = min(T, ffn_chunk) if ffn_chunk else T
+    working = B * (T * 4 * d + t_ffn * 3 * ffn) * p_bytes
     ce_mb = config.get("chunked_ce_budget_mb")
     ce = (int(ce_mb) * 2 ** 20 * 2 if ce_mb
           else B * T * V * (2 if config.get("ce_logits_dtype") else 4))

@@ -87,9 +87,14 @@ class ActivationCheckpointingConfig(TPUConfigModel):
     profile: bool = False
     #: jax-native remat policy: 'none'|'full'|'save_attn_out'|'dots_saveable'|
     #: 'nothing_saveable'|'dots_with_no_batch_dims_saveable', or host-offload
-    #: variants 'offload_attn_out'|'offload_attn_qkv'|'offload_full'|
+    #: variants (see models/transformer.resolve_remat_policy) incl.
     #: 'offload_save_attn_out'
     policy: str = "none"
+    #: sequence-chunked FFN (FPDT's chunked MLP, reference
+    #: fpdt_layer.py:1056): the dense MLP runs ``ffn_chunk``-token tiles
+    #: under remat, so its [T, ffn] activations never materialize — the
+    #: knob that holds 128K+ single-chip training under HBM. 0 = off.
+    ffn_chunk: int = Field(default=0, ge=0)
 
 
 # ---------------------------------------------------------------------------

@@ -127,6 +127,13 @@ class DecoderConfig:
     #: BERT masked-LM head: transform dense+gelu+LN before the tied
     #: decode, plus a vocab bias (HF cls.predictions.*)
     mlm_head: bool = False
+    #: FPDT sequence-chunked dense MLP (reference fpdt_layer.py:1056,
+    #: set from activation_checkpointing.ffn_chunk): >0 runs the MLP in
+    #: ffn_chunk-token tiles under remat so its [T, ffn] activations
+    #: never materialize — the 128K+ single-chip memory knob. Applies
+    #: to the dense MLP path only (MoE layers dispatch per token
+    #: already); inference paths ignore it (decode is 1 token).
+    ffn_chunk: int = 0
 
     def __post_init__(self):
         if self.mlm_head and not self.tie_embeddings:
@@ -490,6 +497,19 @@ def resolve_remat_policy(name: Optional[str]):
                                            "moe_dispatch"],
                 names_which_can_be_offloaded=["block_in"],
                 offload_src="device", offload_dst="pinned_host"),
+        # the 128K+ regime: block inputs AND the flash-kernel residuals
+        # all live in host DRAM — backward re-runs only the projections
+        # and MLP, never the flash forward, and device HBM holds no
+        # per-layer [T, ...] residuals at all. The extra ~1GB/layer of
+        # D2H+H2D traffic vanishes under the attention math at these
+        # sequence lengths (attention is ~97% of step FLOPs at 128K).
+        "offload_save_attn_kernel_host":
+            jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=["moe_dispatch"],
+                names_which_can_be_offloaded=["block_in",
+                                              "attn_kernel_out",
+                                              "attn_lse"],
+                offload_src="device", offload_dst="pinned_host"),
     }
     if name is not None and name not in policies:
         raise ValueError(f"unknown remat policy '{name}'; "
@@ -624,6 +644,13 @@ def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
                     axis=-1).astype(src.dtype)
                 out = out * coef[..., 0:1] + res * coef[..., 1:2]
             return out, aux
+        if cfg.ffn_chunk and src.shape[1] > cfg.ffn_chunk:
+            # FPDT chunked MLP: [T, ffn]-sized activations become
+            # [ffn_chunk, ffn]-sized (parallel/fpdt.fpdt_ffn)
+            from deepspeed_tpu.parallel.fpdt import fpdt_ffn
+            return (fpdt_ffn(partial(_mlp, cfg, p["mlp"]), src,
+                             chunk=cfg.ffn_chunk),
+                    jnp.zeros((), jnp.float32))
         return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32)
 
     if not cfg.prenorm:

@@ -12,9 +12,10 @@ the KV chunk store is placed in ``pinned_host`` memory and each chunk is
 ``device_put`` back inside the scan; XLA's latency-hiding scheduler
 overlaps the H2D stream with the previous chunk's attention math (the
 reference's manual double-buffer streams). Chunked FFN is a remat scan
-over sequence tiles. Composes with Ulysses/ring SP: apply those first
-(heads/sequence repartition), then FPDT chunks whatever sequence length
-lands on each device.
+over sequence tiles. SP composition (Ulysses/ring first, then FPDT
+chunking each shard's local sequence) is a design note, NOT wired up:
+``attention_impl='fpdt'`` is single-shard today and ``select_attention``
+rejects it under ``sequence_parallel.size > 1``.
 """
 
 import math
@@ -52,16 +53,24 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    offload: Optional[bool] = None) -> jax.Array:
     """Chunked online-softmax attention with host-resident KV.
 
-    q/k/v: [B, T, H|KvH, Dh], T divisible by ``chunk``. Peak device KV
-    memory is ONE chunk (+ the accumulators) regardless of T — the rest
-    waits in host DRAM. ``offload=None`` auto-enables when the backend
-    exposes pinned_host memory.
+    q/k/v: [B, T, H|KvH, Dh]. Peak device KV memory is ONE chunk (+ the
+    accumulators) regardless of T — the rest waits in host DRAM.
+    ``offload=None`` auto-enables when the backend exposes pinned_host
+    memory. T not divisible by ``chunk`` is zero-padded at the sequence
+    end (exact: padded keys sit above every real query's causal horizon;
+    padded query rows are sliced off).
     """
+    t_real = q.shape[1]
+    pad = (-t_real) % chunk
+    if pad:
+        def _pad(a):
+            return jnp.concatenate(
+                [a, jnp.zeros((a.shape[0], pad) + a.shape[2:], a.dtype)],
+                axis=1)
+        q, k, v = _pad(q), _pad(k), _pad(v)
     b, t, h, dh = q.shape
     _, _, kvh, _ = k.shape
     groups = h // kvh
-    if t % chunk:
-        raise ValueError(f"seq len {t} not divisible by chunk {chunk}")
     nc = t // chunk
     if offload is None:
         offload = host_offload_supported()
@@ -123,22 +132,30 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     _, chunks = lax.scan(q_chunk_body, None,
                          jnp.arange(nc, dtype=jnp.int32))
     # [nc, b, chunk, h, dh] -> [b, t, h, dh]
-    return chunks.transpose(1, 0, 2, 3, 4).reshape(b, t, h, dh)
+    out = chunks.transpose(1, 0, 2, 3, 4).reshape(b, t, h, dh)
+    return out[:, :t_real] if pad else out
 
 
 def fpdt_ffn(mlp_fn: Callable[[jax.Array], jax.Array], x: jax.Array,
              chunk: int = 1024, remat: bool = True) -> jax.Array:
     """Sequence-chunked FFN (reference FPDT_FFN:1056): the MLP runs one
     sequence tile at a time under remat, so activation memory is one tile.
-    x: [B, T, D]."""
+    x: [B, T, D]. T not divisible by ``chunk`` is handled by zero-padding
+    the last tile (the MLP is per-token, so padding is exact) — silently
+    falling back to the unchunked MLP would OOM in exactly the long-
+    context regime this exists for."""
     b, t, d = x.shape
-    if t % chunk:
-        raise ValueError(f"seq len {t} not divisible by chunk {chunk}")
-    xs = x.reshape(b, t // chunk, chunk, d).transpose(1, 0, 2, 3)
+    pad = (-t) % chunk
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((b, pad, d), x.dtype)], axis=1)
+    tp = t + pad
+    xs = x.reshape(b, tp // chunk, chunk, d).transpose(1, 0, 2, 3)
 
     def body(_, xc):
         return None, mlp_fn(xc)
 
     step = jax.checkpoint(body) if remat else body
     _, out = lax.scan(step, None, xs)
-    return out.transpose(1, 0, 2, 3).reshape(b, t, d)
+    out = out.transpose(1, 0, 2, 3).reshape(b, tp, d)
+    return out[:, :t] if pad else out

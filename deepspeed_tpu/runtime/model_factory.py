@@ -80,11 +80,36 @@ def select_attention(ds_cfg: DeepSpeedTPUConfig,
                 f"apply the bias/window/non-causal mask or results will "
                 f"silently differ")
         return _ATTENTION_REGISTRY[impl]
-    if impl not in ("auto", "pallas_flash", "xla_chunked", "naive"):
+    if impl not in ("auto", "pallas_flash", "xla_chunked", "naive",
+                    "fpdt"):
         raise ValueError(
             f"unknown attention_impl '{impl}'; expected 'auto'|"
-            f"'pallas_flash'|'xla_chunked'|'naive' or a name registered "
-            f"via register_attention_impl ({sorted(_ATTENTION_REGISTRY)})")
+            f"'pallas_flash'|'xla_chunked'|'naive'|'fpdt' or a name "
+            f"registered via register_attention_impl "
+            f"({sorted(_ATTENTION_REGISTRY)})")
+    if impl == "fpdt":
+        # FPDT chunked attention (reference fpdt_layer.py:510): q-chunked
+        # online softmax with the KV store in pinned host DRAM — the
+        # 256K+ single-chip regime, where even the flash kernel's
+        # backward transients ([T, q_dim] q/k/v + dq/dk/dv) overflow
+        # HBM. DSTPU_FPDT_CHUNK tunes the q/KV chunk (default 4096).
+        if sp.size > 1:
+            raise ValueError(
+                "attention_impl 'fpdt' composes with sequence parallel "
+                "by chunking each shard's local sequence — but the SP "
+                "wrappers are applied instead of it today; use "
+                "'auto' with sequence_parallel, or fpdt on one chip")
+        if dec_cfg is not None and (
+                not dec_cfg.causal or dec_cfg.pos_emb == "alibi"
+                or dec_cfg.sliding_window is not None
+                or dec_cfg.layer_window_pattern):
+            raise ValueError(
+                "attention_impl 'fpdt' supports full-causal decoders "
+                "only (no ALiBi/sliding-window/encoder)")
+        from deepspeed_tpu.parallel.fpdt import fpdt_attention
+        return partial(fpdt_attention,
+                       chunk=int(os.environ.get("DSTPU_FPDT_CHUNK",
+                                                4096)))
     if dec_cfg is not None and dec_cfg.layer_window_pattern:
         # per-layer alternating windows (GPT-Neo): the window is a traced
         # scalar fed from the layer scan, which only the masked reference
@@ -214,6 +239,14 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
         # into the model config before init/loss/specs are built
         import dataclasses
         dec_cfg = dataclasses.replace(dec_cfg, moe_residual=True)
+
+    if ds_cfg.activation_checkpointing.ffn_chunk:
+        # FPDT sequence-chunked MLP (memory knob, not architecture —
+        # but the forward reads it from the model config)
+        import dataclasses
+        dec_cfg = dataclasses.replace(
+            dec_cfg,
+            ffn_chunk=int(ds_cfg.activation_checkpointing.ffn_chunk))
 
     attn_fn = select_attention(ds_cfg, dec_cfg)
     moe_fn = select_moe(dec_cfg, ds_cfg)

@@ -326,7 +326,8 @@ def test_save_attn_kernel_remat_policy(devices):
         0, 256, size=(8, 32)), np.int32)}
     losses = {}
     for policy in ("save_attn_out", "save_attn_kernel",
-                   "offload_save_attn_kernel"):
+                   "offload_save_attn_kernel",
+                   "offload_save_attn_kernel_host"):
         build_mesh(data=8)
         engine, _, _, _ = ds.initialize(
             model=cfg,
@@ -340,6 +341,9 @@ def test_save_attn_kernel_remat_policy(devices):
                           for _ in range(3)]
     np.testing.assert_allclose(losses["save_attn_out"],
                                losses["save_attn_kernel"], rtol=1e-5)
+    np.testing.assert_allclose(losses["save_attn_out"],
+                               losses["offload_save_attn_kernel_host"],
+                               rtol=1e-5)
     np.testing.assert_allclose(losses["save_attn_out"],
                                losses["offload_save_attn_kernel"],
                                rtol=1e-5)
@@ -407,3 +411,31 @@ def test_ce_bf16_logits_close_to_fp32(devices):
                                             "params": {"lr": 1e-3}},
                               "ce_logits_dtype": "fp8"},
                       rng=jax.random.PRNGKey(0))
+
+
+def test_ffn_chunk_wiring_and_parity(devices):
+    """activation_checkpointing.ffn_chunk reaches the forward (config ->
+    model_factory dataclasses.replace -> block_combine's fpdt_ffn branch)
+    and changes memory layout only, never math — including a chunk that
+    does NOT divide the sequence length (padded last tile)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    cfg = llama3_config("tiny", max_seq_len=48, vocab_size=256)
+    batch = {"input_ids": np.asarray(np.random.default_rng(3).integers(
+        0, 256, size=(8, 48)), np.int32)}
+    losses = {}
+    for chunk in (0, 16, 20):           # 20 does not divide 48
+        build_mesh(data=8)
+        engine, _, _, _ = ds.initialize(
+            model=cfg,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "activation_checkpointing": {"policy": "save_attn_out",
+                                                 "ffn_chunk": chunk}},
+            rng=jax.random.PRNGKey(0))
+        assert engine.model.decoder_config.ffn_chunk == chunk
+        losses[chunk] = [float(engine.train_batch(iter([batch])))
+                        for _ in range(3)]
+    np.testing.assert_allclose(losses[0], losses[16], rtol=2e-5)
+    np.testing.assert_allclose(losses[0], losses[20], rtol=2e-5)
