@@ -235,10 +235,7 @@ def main() -> None:
         "activation_checkpointing": {
             "policy": os.environ.get(
                 "DSTPU_BENCH_REMAT",
-                # at 256K+ attention runs via fpdt (no flash-kernel
-                # residual names to park) -> plain offload_full
-                ("offload_full" if seq >= 262144
-                 else "offload_save_attn_kernel_host" if seq >= 65536
+                ("offload_save_attn_kernel_host" if seq >= 65536
                  else "offload_save_attn_kernel" if seq >= 32768
                  else "save_attn_kernel") if on_tpu else "none"),
             # FPDT regime: at 64K+ the [T, ffn] MLP activations alone
@@ -251,11 +248,10 @@ def main() -> None:
         # bytes, so the budget halves with the dtype (+0.7 MFU vs fp32)
         "ce_logits_dtype": "bf16" if on_tpu else None,
         "chunked_ce_budget_mb": 256 if on_tpu else None,
-        # 256K+: even flash-kernel backward transients overflow HBM —
-        # FPDT chunked attention with host-resident KV
-        "attention_impl": os.environ.get(
-            "DSTPU_BENCH_ATTN",
-            "fpdt" if (on_tpu and seq >= 262144) else "auto"),
+        # flash + host-offloaded residuals carries training to 256K;
+        # attention_impl=fpdt stays opt-in (forward/serving oriented —
+        # its reverse-mode AD stores per-chunk softmax intermediates)
+        "attention_impl": os.environ.get("DSTPU_BENCH_ATTN", "auto"),
         "steps_per_print": 1000,
     }
     # DSTPU_BENCH_OFFLOAD=cpu|cpu_overlap|zenflow: measure the ZeRO-Offload

@@ -43,10 +43,51 @@ class TuneResult:
     predicted_oom: bool = False
     #: memory-model breakdown in bytes (also set for measured candidates)
     predicted_hbm: Optional[Dict[str, float]] = None
+    #: backend-reported peak HBM bytes for candidates that actually ran
+    #: (None when the backend exposes no memory stats)
+    measured_hbm: Optional[int] = None
 
     @property
     def feasible(self) -> bool:
         return self.error is None
+
+
+def device_peak_bytes() -> Optional[int]:
+    """Backend-reported peak HBM in use (None when unavailable — e.g.
+    the CPU backend). Reset is not exposed by all runtimes, so callers
+    compare peaks measured after their own workload ran."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return int(peak) if peak else None
+    except Exception:
+        return None
+
+
+def calibration_report(results, tolerance: float = 0.20) -> Dict[str, Any]:
+    """Predicted-vs-measured HBM calibration over the candidates that
+    actually ran (VERDICT r4 #7: an uncalibrated model re-introduces the
+    OOM-by-building failure mode it exists to prevent). ``ok`` is False
+    when any candidate's |predicted - measured| / measured exceeds
+    ``tolerance`` — the sweep report carries the failure loudly."""
+    rows = []
+    for r in results:
+        if r.measured_hbm and r.predicted_hbm and r.error is None:
+            pred = float(r.predicted_hbm["total"])
+            meas = float(r.measured_hbm)
+            rows.append({
+                "micro_batch": r.config.get(
+                    "train_micro_batch_size_per_gpu"),
+                "zero_stage": (r.config.get("zero_optimization", {})
+                               or {}).get("stage"),
+                "predicted_gib": round(pred / 2**30, 3),
+                "measured_gib": round(meas / 2**30, 3),
+                "pct_error": round((pred - meas) / meas * 100.0, 1),
+            })
+    worst = max((abs(c["pct_error"]) for c in rows), default=0.0)
+    return {"tolerance_pct": tolerance * 100.0, "candidates": rows,
+            "max_abs_pct_error": worst,
+            "ok": worst <= tolerance * 100.0}
 
 
 def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,
@@ -205,10 +246,15 @@ class Autotuner:
                             cfg["chunked_ce_budget_mb"] = ce_mb
                         yield cfg
 
-    def _measure(self, cfg: Dict[str, Any]) -> TuneResult:
+    def _measure(self, cfg: Dict[str, Any],
+                 pred: Optional[Dict[str, float]] = None) -> TuneResult:
         from deepspeed_tpu.parallel.mesh import get_mesh
         from deepspeed_tpu.runtime.engine import initialize
         mbs = cfg["train_micro_batch_size_per_gpu"]
+        # the cumulative peak BEFORE this candidate: peak_bytes_in_use is
+        # monotone (no reset API), so a candidate's own peak is only
+        # observable when it sets a new high-water mark
+        peak_before = device_peak_bytes()
         try:
             # chunked_ce_budget_mb is a REAL config key, so the winning
             # config in autotune_best.json reproduces the measured run
@@ -230,22 +276,34 @@ class Autotuner:
             float(loss)
             dt = (time.perf_counter() - t0) / self.steps
             tput = int(engine.config.train_batch_size) / dt
-            return TuneResult(config=cfg, throughput=tput, step_time=dt)
+            peak_after = device_peak_bytes()
+            measured = (peak_after if peak_after and
+                        (peak_before is None or peak_after > peak_before)
+                        else None)       # stale high-water mark: unknown
+            return TuneResult(config=cfg, throughput=tput, step_time=dt,
+                              predicted_hbm=pred, measured_hbm=measured)
         except Exception as e:          # OOM / invalid combo → infeasible
             logger.warning(f"autotune candidate failed: {e}")
             return TuneResult(config=cfg, throughput=0.0, step_time=0.0,
                               error=str(e)[:500])
 
-    def _predict(self, cfg: Dict[str, Any]) -> Optional[TuneResult]:
-        """Memory-model gate: return a predicted-OOM result (skip the
-        build entirely) or None when the candidate fits the HBM budget."""
+    def _predict(self, cfg: Dict[str, Any]):
+        """Memory-model gate → (gate_result, estimate): gate_result is a
+        predicted-OOM TuneResult (skip the build entirely) or None when
+        the candidate fits; the estimate threads into _measure so the
+        calibration record reuses it instead of recomputing."""
         dec = self._decoder_config()
         if not self.memory_model or dec is None:
-            return None
+            return None, None
         from deepspeed_tpu.parallel.mesh import get_mesh
-        est = estimate_candidate_hbm(dec, cfg, get_mesh())
+        try:
+            est = estimate_candidate_hbm(dec, cfg, get_mesh())
+        except Exception as e:      # a model the estimator can't shape
+            logger.warning(f"autotune memory model failed ({e}); "
+                           f"building the candidate unguarded")
+            return None, None
         if est["total"] <= self.hbm_bytes:
-            return None
+            return None, est
         return TuneResult(
             config=cfg, throughput=0.0, step_time=0.0,
             error=(f"predicted OOM: {est['total'] / 2**30:.2f} GiB > "
@@ -253,13 +311,14 @@ class Autotuner:
                    f"(params {est['params'] / 2**30:.2f}, opt "
                    f"{est['opt'] / 2**30:.2f}, acts "
                    f"{est['activations'] / 2**30:.2f})"),
-            predicted_oom=True, predicted_hbm=est)
+            predicted_oom=True, predicted_hbm=est), est
 
     def tune(self, results_dir: Optional[str] = None) -> TuneResult:
         """Run the sweep; returns the best feasible candidate (reference
         autotuner 'tune' + results json output)."""
         for cfg in self._candidates():
-            res = self._predict(cfg) or self._measure(cfg)
+            gate, est = self._predict(cfg)
+            res = gate or self._measure(cfg, pred=est)
             self.results.append(res)
             extras = ""
             ac = cfg.get("activation_checkpointing", {}).get("policy")
@@ -276,17 +335,33 @@ class Autotuner:
         if not feasible:
             raise RuntimeError("autotuning found no feasible config")
         best = max(feasible, key=lambda r: r.throughput)
+        cal = calibration_report(self.results)
+        if cal["candidates"] and not cal["ok"]:
+            logger.error(
+                f"autotune memory-model calibration FAILED: worst "
+                f"|predicted-measured| = {cal['max_abs_pct_error']:.1f}% "
+                f"> {cal['tolerance_pct']:.0f}% tolerance — the predicted-"
+                f"OOM gate may prune configs that fit (or admit ones "
+                f"that don't); details in autotune_results.json")
         if results_dir:
             os.makedirs(results_dir, exist_ok=True)
             with open(os.path.join(results_dir, "autotune_results.json"),
                       "w") as fh:
-                json.dump([{"config": r.config,
-                            "throughput": r.throughput,
-                            "step_time": r.step_time,
-                            "error": r.error,
-                            "predicted_oom": r.predicted_oom}
-                           for r in self.results],
-                          fh, indent=1)
+                json.dump({"candidates": [
+                    {"config": r.config,
+                     "throughput": r.throughput,
+                     "step_time": r.step_time,
+                     "error": r.error,
+                     "predicted_oom": r.predicted_oom,
+                     "predicted_hbm_gib": (
+                         round(r.predicted_hbm["total"] / 2**30, 3)
+                         if r.predicted_hbm else None),
+                     "measured_hbm_gib": (
+                         round(r.measured_hbm / 2**30, 3)
+                         if r.measured_hbm else None)}
+                    for r in self.results],
+                    "calibration": cal},
+                    fh, indent=1)
             with open(os.path.join(results_dir, "autotune_best.json"),
                       "w") as fh:
                 json.dump(best.config, fh, indent=1)

@@ -180,6 +180,8 @@ def axis_index(axis_name: AxisName) -> jax.Array:
     return lax.axis_index(axis_name)
 
 
-def log_summary() -> None:
-    """Reference comm.py:435 (log_summary)."""
-    comms_logger.log_summary()
+def log_summary(show_straggler: bool = False) -> None:
+    """Reference comm.py:435 (log_summary): ``show_straggler`` gathers
+    per-process op timings and prints the cross-rank min/max split into
+    transmit vs wait time (utils/comms_logging.py:67)."""
+    comms_logger.log_summary(show_straggler=show_straggler)

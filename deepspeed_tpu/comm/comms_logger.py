@@ -76,13 +76,85 @@ class CommsLogger:
     def has_records(self, op_name: str) -> bool:
         return op_name in self.comms_dict
 
-    def log_summary(self) -> None:
+    def log_summary(self, show_straggler: bool = False) -> None:
+        """Reference ``CommsLogger.log_all(show_straggler=...)``
+        (utils/comms_logging.py:67, comm/comm.py:435): the straggler view
+        gathers each process's per-op totals and splits a rank's time
+        into TRANSMIT (the fastest rank's time — what the wire costs)
+        and WAIT (everything above it — time spent blocked on slower
+        ranks). One process degenerates to wait = 0 everywhere."""
         lines = [f"{'op':<18}{'size':>12}{'count':>8}{'total ms':>12}"]
         for op_name, sizes in sorted(self.comms_dict.items()):
             for size, (count, total) in sorted(sizes.items()):
                 lines.append(f"{op_name:<18}{convert_size(size):>12}"
                              f"{count:>8}{total * 1e3:>12.2f}")
         log_dist("\n".join(lines))
+        if show_straggler:
+            import jax
+            all_ranks = _gather_comm_records(self._records_payload())
+            log_dist("\n".join(straggler_rows(
+                all_ranks, own_rank=jax.process_index())))
+
+    def _records_payload(self) -> Dict[str, Dict[int, List[float]]]:
+        return {op: {int(s): [int(c), float(t)]
+                     for s, (c, t) in sizes.items()}
+                for op, sizes in self.comms_dict.items()}
+
+
+def straggler_rows(all_ranks: List[Dict[str, Dict[int, List[float]]]],
+                   own_rank: int = 0) -> List[str]:
+    """Pure straggler analysis over every rank's {op: {size: [count,
+    total_sec]}} records → formatted table rows. For each (op, size):
+    transmit = min total across ranks (what the collective itself
+    costs); wait(rank) = own total − transmit (time blocked on
+    stragglers); the max-total rank is named as the straggler."""
+    rows = [f"{'op':<18}{'size':>12}{'min ms':>10}{'max ms':>10}"
+            f"{'max rank':>10}{'own wait ms':>13}"]
+    keys = sorted({(op, size) for r in all_ranks
+                   for op, sizes in r.items() for size in sizes})
+    for op, size in keys:
+        # only ranks that actually RECORDED this (op, size) participate:
+        # defaulting absentees to 0 would drive the transmit estimate to
+        # zero and misattribute the whole time as wait
+        present = [(i, r[op][size][1]) for i, r in enumerate(all_ranks)
+                   if size in r.get(op, {})]
+        totals = [t for _, t in present]
+        t_min = min(totals)
+        t_max = max(totals)
+        max_rank = present[totals.index(t_max)][0]
+        own = dict(present).get(own_rank)
+        wait = (own - t_min) if own is not None else 0.0
+        rows.append(f"{op:<18}{convert_size(size):>12}"
+                    f"{t_min * 1e3:>10.2f}{t_max * 1e3:>10.2f}"
+                    f"{max_rank:>10}"
+                    f"{wait * 1e3:>13.2f}")
+    return rows
+
+
+def _gather_comm_records(payload) -> List[Dict]:
+    """Allgather each process's records dict (JSON over fixed-width u8
+    arrays — process_allgather needs equal shapes, so lengths go first).
+    Single-process: just [payload]."""
+    import jax
+    if jax.process_count() == 1:
+        return [payload]
+    import json as _json
+    import numpy as _np
+    from jax.experimental import multihost_utils as mh
+    raw = _json.dumps(payload, sort_keys=True).encode()
+    lens = mh.process_allgather(_np.asarray([len(raw)], _np.int32))
+    width = int(lens.max())
+    buf = _np.zeros((width,), _np.uint8)
+    buf[:len(raw)] = _np.frombuffer(raw, _np.uint8)
+    bufs = mh.process_allgather(buf)
+    out = []
+    for i in range(bufs.shape[0]):
+        n = int(lens.reshape(-1)[i])
+        rec = _json.loads(bytes(bufs[i, :n]).decode())
+        # JSON stringifies the int size keys — restore them
+        out.append({op: {int(s): v for s, v in sizes.items()}
+                    for op, sizes in rec.items()})
+    return out
 
 
 comms_logger = CommsLogger()

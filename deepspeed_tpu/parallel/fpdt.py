@@ -59,6 +59,14 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     memory. T not divisible by ``chunk`` is zero-padded at the sequence
     end (exact: padded keys sit above every real query's causal horizon;
     padded query rows are sliced off).
+
+    TRAINING CAUTION: reverse-mode AD through the chunk loops stores
+    per-iteration softmax intermediates (O(T²) bytes across the loop) —
+    fine at the lengths the tests cover, ruinous at 100K+. For
+    long-context TRAINING use the Pallas flash path with the
+    ``offload_save_attn_kernel_host`` remat policy (its custom VJP
+    recomputes scores from out/lse); fpdt attention serves forward/
+    serving paths and shapes the flash kernel does not support.
     """
     t_real = q.shape[1]
     pad = (-t_real) % chunk

@@ -89,7 +89,9 @@ def _cost(fn, *abstract_args) -> Dict[str, float]:
 
 def module_profile(dec_cfg, batch_size: int = 1,
                    seq_len: Optional[int] = None,
-                   dtype=None, top_k: int = 10) -> Dict[str, Any]:
+                   dtype=None, top_k: int = 10,
+                   measure: bool = False,
+                   measure_iters: int = 8) -> Dict[str, Any]:
     """Per-module forward flops/bytes/params breakdown (reference
     flops_profiler builds this tree by monkey-patching every torch module,
     profiler.py:511-861; here each named component is lowered separately
@@ -100,6 +102,14 @@ def module_profile(dec_cfg, batch_size: int = 1,
     plus ``top`` — the top-k leaf cost centers with percentages. The
     per-layer row is measured once and multiplied by num_layers (layers
     are homogeneous by construction — one stacked scan block).
+
+    ``measure=True`` additionally RUNS each component jitted on the
+    current backend with random concrete inputs and attaches measured
+    wall time (``ms`` per row, iteration-chained inside one jit with a
+    scalar fetch so remote-runtime dispatch noise does not pollute the
+    number — the reference profiler's measured per-module duration,
+    profiler.py:511). Costs one compile + ``measure_iters`` runs per
+    component.
     """
     import jax.numpy as jnp
     from deepspeed_tpu.models import transformer as T
@@ -171,14 +181,51 @@ def module_profile(dec_cfg, batch_size: int = 1,
          n_params(abstract_params.get("lm_head", {}))),
     ]
 
+    def _measure_ms(fn, abstract_args) -> float:
+        """Wall ms per call: concrete random inputs, one jit whose body
+        chains `measure_iters` dependent calls, scalar fetched."""
+        import time as _time
+        from jax import lax as _lax
+
+        def _concrete(s):
+            if np.issubdtype(s.dtype, np.integer):
+                return jnp.zeros(s.shape, s.dtype)
+            return jnp.full(s.shape, 0.01, s.dtype)
+
+        args_c = jax.tree.map(_concrete, tuple(abstract_args))
+
+        def chained(*a):
+            def step(_, carry):
+                # thread the carry into the inputs as a runtime ~0 so
+                # XLA cannot hoist the body out of the loop
+                eps = carry * 1e-30
+
+                def bump(l):
+                    if jnp.issubdtype(l.dtype, jnp.floating):
+                        return l + eps.astype(l.dtype)
+                    return l
+                out = fn(jax.tree.map(bump, a[0]), *a[1:])
+                out0 = out[0] if isinstance(out, tuple) else out
+                return jnp.sum(out0.astype(jnp.float32)) * 1e-9
+
+            return _lax.fori_loop(0, measure_iters, step, jnp.float32(0.0))
+        jf = jax.jit(chained)
+        float(jf(*args_c))                       # compile + warm
+        t0 = _time.perf_counter()
+        float(jf(*args_c))
+        return (_time.perf_counter() - t0) / measure_iters * 1e3
+
     leaves = []
     for name, fn, args, params in rows:
         c = _cost(fn, *args)
         mult = cfg.num_layers if name.startswith("layer.") else 1
-        leaves.append({"name": name + (f" x{mult}" if mult > 1 else ""),
-                       "flops": c["flops"] * mult,
-                       "bytes": c["bytes"] * mult,
-                       "params": params * mult})
+        row = {"name": name + (f" x{mult}" if mult > 1 else ""),
+               "flops": c["flops"] * mult,
+               "bytes": c["bytes"] * mult,
+               "params": params * mult}
+        if measure:
+            row["ms"] = _measure_ms(fn, args) * mult
+        leaves.append(row)
     total_fl = sum(r["flops"] for r in leaves) or 1.0
     for r in leaves:
         r["pct"] = 100.0 * r["flops"] / total_fl
@@ -187,7 +234,10 @@ def module_profile(dec_cfg, batch_size: int = 1,
             "bytes": sum(r["bytes"] for r in leaves),
             "params": sum(r["params"] for r in leaves),
             "children": leaves,
-            "top": sorted(leaves, key=lambda r: -r["flops"])[:top_k]}
+            "top": sorted(leaves,
+                          key=lambda r: -r.get("ms", r["flops"]))[:top_k]}
+    if measure:
+        tree["ms"] = sum(r["ms"] for r in leaves)
     return tree
 
 
@@ -200,7 +250,8 @@ def format_module_profile(tree: Dict[str, Any]) -> str:
         lines.append(
             f"  {r['name']:<24s} {r['flops'] / 1e9:10.2f} GF "
             f"{r['pct']:5.1f}%  {r['bytes'] / 2**20:10.1f} MiB  "
-            f"{r['params'] / 1e6:8.2f}M")
+            f"{r['params'] / 1e6:8.2f}M"
+            + (f"  {r['ms']:8.2f} ms" if "ms" in r else ""))
     return "\n".join(lines)
 
 

@@ -125,6 +125,44 @@ def test_comms_logger_records(devices):
     comms_logger.enabled = False
 
 
+def test_comms_straggler_summary_surfaces_skewed_rank():
+    """VERDICT r4 #8: the cross-rank straggler view names the slow rank
+    and splits wait from transmit. Synthetic 4-rank records with rank 2
+    deliberately 10x slower on the grad all_reduce; one-process
+    log_summary(show_straggler=True) also runs end-to-end (degenerate
+    wait = 0)."""
+    from deepspeed_tpu.comm.comms_logger import (comms_logger,
+                                                 straggler_rows)
+    base = {"all_reduce": {1 << 20: [10, 0.020]},
+            "all_gather": {1 << 18: [4, 0.004]}}
+    ranks = []
+    for r in range(4):
+        rec = {op: {s: list(v) for s, v in sizes.items()}
+               for op, sizes in base.items()}
+        if r == 2:
+            rec["all_reduce"][1 << 20][1] = 0.200      # the straggler
+        ranks.append(rec)
+    rows = straggler_rows(ranks, own_rank=0)
+    ar = next(l for l in rows if l.startswith("all_reduce"))
+    cols = ar.split()
+    # min 20ms, max 200ms, straggler rank 2, own wait 0 (rank 0 == min)
+    assert float(cols[3]) == 20.0 and float(cols[4]) == 200.0
+    assert cols[5] == "2" and float(cols[6]) == 0.0
+    rows_own = straggler_rows(ranks, own_rank=2)
+    ar2 = next(l for l in rows_own if l.startswith("all_reduce"))
+    assert float(ar2.split()[6]) == 180.0              # waits 180ms
+    ag = next(l for l in rows if l.startswith("all_gather"))
+    assert float(ag.split()[6]) == 0.0                 # no skew there
+
+    # end-to-end: one-process gather path
+    comms_logger.enabled = True
+    comms_logger.reset()
+    comms_logger.append("all_reduce", 1 << 20, time_sec=0.01)
+    comms_logger.log_summary(show_straggler=True)
+    comms_logger.enabled = False
+    comms_logger.reset()
+
+
 def test_module_profile_breakdown():
     """VERDICT r3 #9: per-module flops/bytes breakdown with names for the
     top cost centers — per-component XLA cost analysis over abstract
@@ -165,3 +203,21 @@ def test_module_profile_moe():
     cfg = mixtral_config("tiny", max_seq_len=32)
     tree = module_profile(cfg, batch_size=1, seq_len=32)
     assert any("moe" in r["name"] for r in tree["children"])
+
+
+def test_module_profile_measured_latency(devices):
+    """VERDICT r4 #9: the per-module tree carries MEASURED per-block wall
+    time alongside the analytic flops (reference profiler.py:511 reports
+    per-module duration). The measured total is finite/positive, every
+    leaf has an ms entry, and 'top' ranks by measured time."""
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.profiling.flops_profiler import (
+        format_module_profile, module_profile)
+    cfg = llama3_config("tiny", max_seq_len=64)
+    tree = module_profile(cfg, batch_size=2, seq_len=64, measure=True,
+                          measure_iters=3)
+    assert tree["ms"] > 0
+    for r in tree["children"]:
+        assert r["ms"] >= 0
+    assert tree["top"][0]["ms"] == max(r["ms"] for r in tree["children"])
+    assert "ms" in format_module_profile(tree)
