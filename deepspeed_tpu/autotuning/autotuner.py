@@ -87,7 +87,15 @@ def calibration_report(results, tolerance: float = 0.20) -> Dict[str, Any]:
     worst = max((abs(c["pct_error"]) for c in rows), default=0.0)
     return {"tolerance_pct": tolerance * 100.0, "candidates": rows,
             "max_abs_pct_error": worst,
-            "ok": worst <= tolerance * 100.0}
+            # None (not True) when nothing was measurable: an empty
+            # calibration must not read as a passing one
+            "ok": (worst <= tolerance * 100.0) if rows else None,
+            "caveat": ("peak_bytes_in_use is process-cumulative: a "
+                       "candidate's measurement can include residual "
+                       "live buffers from earlier candidates, and "
+                       "candidates that never exceed the prior peak "
+                       "record no measurement — run single-candidate "
+                       "sweeps for a clean calibration")}
 
 
 def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,

@@ -183,5 +183,7 @@ def axis_index(axis_name: AxisName) -> jax.Array:
 def log_summary(show_straggler: bool = False) -> None:
     """Reference comm.py:435 (log_summary): ``show_straggler`` gathers
     per-process op timings and prints the cross-rank min/max split into
-    transmit vs wait time (utils/comms_logging.py:67)."""
+    transmit vs wait time (utils/comms_logging.py:67). With
+    ``show_straggler`` this is a COLLECTIVE under multi-process — every
+    process must call it, not just rank 0."""
     comms_logger.log_summary(show_straggler=show_straggler)

@@ -82,7 +82,12 @@ class CommsLogger:
         gathers each process's per-op totals and splits a rank's time
         into TRANSMIT (the fastest rank's time — what the wire costs)
         and WAIT (everything above it — time spent blocked on slower
-        ranks). One process degenerates to wait = 0 everywhere."""
+        ranks). One process degenerates to wait = 0 everywhere.
+
+        COLLECTIVE under multi-process: ``show_straggler=True`` enters a
+        process allgather, so EVERY process must make this call (a
+        rank-0-only call would hang on the rendezvous) — same contract
+        as the reference's dist.all_gather-based straggler table."""
         lines = [f"{'op':<18}{'size':>12}{'count':>8}{'total ms':>12}"]
         for op_name, sizes in sorted(self.comms_dict.items()):
             for size, (count, total) in sorted(sizes.items()):

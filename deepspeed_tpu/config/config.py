@@ -165,6 +165,12 @@ class ZenFlowTPUConfig(TPUConfigModel):
     #: tail lr by the number of accumulated steps (total movement matches
     #: the synchronous path); 1.0 reproduces the reference exactly
     tail_lr_scale: Union[str, float] = "auto"
+    #: dp>1: rank selection per-shard over dp contiguous block ranges
+    #: (the reference stage-3 per-rank selection,
+    #: runtime/zenflow/engine_stage3.py). Off by default: on the
+    #: single-controller runtime global top-K costs the same and selects
+    #: strictly better; the total K budget is preserved either way.
+    shard_selection: bool = False
 
     @model_validator(mode="after")
     def _validate(self) -> "ZenFlowTPUConfig":

@@ -87,6 +87,47 @@ class DSElasticAgent:
         return tag
 
 
+def elastic_resume(model, ds_config: Dict[str, Any], save_dir: str,
+                   world_size: int, devices=None, rng=None):
+    """Re-form training at a NEW world size from the latest checkpoint.
+
+    The reference agent restarts its worker group through a rendezvous at
+    whatever world size re-admits (elasticity/elastic_agent.py:127 +
+    compute_elastic_config:233); the TPU analogue: solve the elastic
+    batch triple for ``world_size``, rebuild the mesh over that many
+    devices, initialize a fresh engine, and resume from the universal
+    checkpoint (which is layout-free by construction — any dp/tp
+    topology can load it). Returns (engine, agent, resumed_tag).
+
+    ``ds_config`` must carry an enabled ``elasticity`` block; its batch
+    triple is OVERWRITTEN with the solver's choice for the new world.
+    """
+    import copy
+
+    import jax
+
+    from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    cfg = copy.deepcopy(ds_config if isinstance(ds_config, dict)
+                        else ds_config.to_dict())
+    batch, _valid, micro = compute_elastic_config(cfg,
+                                                  world_size=world_size)
+    cfg["train_batch_size"] = batch
+    cfg["train_micro_batch_size_per_gpu"] = micro
+    cfg.pop("gradient_accumulation_steps", None)   # solver-derived
+    devs = devices if devices is not None else jax.devices()[:world_size]
+    build_mesh(data=world_size, devices=devs)
+    engine, *_ = initialize(model=model, config=cfg, rng=rng)
+    agent = DSElasticAgent(engine, save_dir)
+    agent.install()
+    tag = agent.resume()
+    log_dist(f"elastic_resume: world={world_size} batch={batch} "
+             f"micro={micro} resumed={tag or 'fresh start'}")
+    return engine, agent, tag
+
+
 def run_elastic(train_fn: Callable[[int], Any], max_restarts: int = 3
                 ) -> Any:
     """In-process restart loop (reference DSElasticAgent._invoke_run:127

@@ -28,9 +28,17 @@ the LAYER of the stacked decoder:
   narrows the new master straight back into ``params.bin``; the resident
   embed/head re-upload, and the next forward streams fresh layer weights.
 
-Scope (checked at construction): dense decoders, gradient_accumulation 1,
-bf16/fp32 (no fp16 loss scaling), no pipeline/SP/MoE composition — the
-reference's swapper has the same "one partition in flight" character.
+Composes with gradient accumulation (microbatches past the first
+accumulate into ``grads.bin`` by read-modify-write — the reference
+swapper's gradient-partition pass, with the global-norm computed from
+the final accumulated values) and with a dp>1 mesh (batch sharded over
+the data axes, streamed layer weights replicated; GSPMD inserts the
+gradient reductions). Remaining scope fences (checked at construction,
+loud errors): dense decoders only, bf16/fp32 (no fp16 loss scaling), no
+pipeline/SP/MoE composition; the file store itself is one per host —
+per-host sharded partition files (the reference swapper's per-rank
+files) are a multi-host concern this single-controller runtime does not
+exercise.
 """
 
 import math
@@ -117,11 +125,6 @@ class ParamStreamCoordinator:
             raise ValueError(
                 "offload_param does not compose with pipeline/sequence "
                 "parallelism (one streaming schedule at a time)")
-        if int(cfg.gradient_accumulation_steps) != 1:
-            raise ValueError(
-                "offload_param requires gradient_accumulation_steps=1 "
-                "(accumulation would need a grads read-modify-write pass "
-                "per microbatch; stream bigger microbatches instead)")
         if engine.fp16_enabled:
             raise ValueError("offload_param requires bf16/fp32")
         if not isinstance(engine.host_optimizer, NVMeOffloadOptimizer):
@@ -130,8 +133,29 @@ class ParamStreamCoordinator:
                 "(or 'cpu', which maps to the same tier on /dev/shm) — "
                 "the master weights live in the tiered store")
         self.dec = dec
+        self.gas = int(cfg.gradient_accumulation_steps)
         self.opt = engine.host_optimizer
         self.layout: FlatLayout = self.opt.layout
+        # dp>1 mesh: the layer step runs SPMD with the batch sharded over
+        # the data axes and the streamed layer weights replicated — GSPMD
+        # inserts the gradient psum, so the grads written to the store
+        # are already the data-parallel mean's numerator
+        from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
+        self._mesh = get_mesh() if has_mesh() else None
+        self._dp = 1
+        if self._mesh is not None:
+            for a in ("data", "data_inner", "expert"):
+                self._dp *= self._mesh.shape.get(a, 1)
+        if self._mesh is not None and self._dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            axes = tuple(a for a in ("data", "data_inner", "expert")
+                         if self._mesh.shape.get(a, 1) > 1)
+            spec = axes if len(axes) > 1 else axes[0]
+            self._batch_sharding = NamedSharding(self._mesh,
+                                                 P(spec))
+            self._repl_sharding = NamedSharding(self._mesh, P())
+        else:
+            self._batch_sharding = self._repl_sharding = None
         self._abstract = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
             engine._abstract_params)
@@ -150,7 +174,11 @@ class ParamStreamCoordinator:
         self._seed_store(engine.params)
         # device params are now redundant — the store is authoritative;
         # keep only the resident (non-layer) subtree on device
+        # (replicated across the mesh when dp > 1)
         self.resident = {k: engine.params[k] for k in self._resident_keys}
+        if self._repl_sharding is not None:
+            self.resident = jax.device_put(self.resident,
+                                           self._repl_sharding)
         engine.params = None
         log_dist(
             f"ZeRO-Infinity param tier: {self.layout.total * self._p_item / 2**30:.2f} "
@@ -206,10 +234,13 @@ class ParamStreamCoordinator:
 
         self._j_layer_vjp = jax.jit(layer_vjp)
 
-        def head_vjp(res, x, labels):
+        def head_vjp(res, x, labels, seed):
+            # seed = 1/gas: scales every downstream cotangent so the
+            # accumulated grads are the MEAN over microbatches (matching
+            # the fused engine path) with zero extra passes
             loss, vjp = jax.vjp(
                 lambda r, xx: head_loss(r, xx, labels), res, x)
-            dres, dx = vjp(jnp.float32(1.0))
+            dres, dx = vjp(seed)
             return loss, dx, dres
 
         self._j_head_vjp = jax.jit(head_vjp)
@@ -232,17 +263,37 @@ class ParamStreamCoordinator:
             self.params_store.read(buf.view(np.uint8).view(np_dt), off)
             chunks.append(buf)
         self.params_store.drain()
-        return jax.tree.map(jnp.asarray,
+        tree = jax.tree.map(jnp.asarray,
                             self.lr_ranges.unflatten_layer(chunks))
+        if self._repl_sharding is not None:
+            tree = jax.device_put(tree, self._repl_sharding)
+        return tree
 
-    def _write_layer_grads(self, l: int, dlp: Pytree) -> float:
-        """D2H layer grads → grads.bin (fp32); returns the sum of squares
-        (for the exact global-norm clip)."""
+    def _write_layer_grads(self, l: int, dlp: Pytree,
+                           accumulate: bool = False,
+                           want_ssq: bool = True) -> float:
+        """D2H layer grads → grads.bin (fp32); ``accumulate`` adds to the
+        chunk already in the store (microbatches 2..gas — the reference
+        swapper's read-modify-write grad partition pass). Returns the sum
+        of squares of the WRITTEN values when ``want_ssq`` (only the last
+        microbatch's values are the step's true gradient)."""
         leaves = self.lr_ranges.treedef.flatten_up_to(dlp)
+        ranges = self.lr_ranges.ranges(l)
+        prevs = None
+        if accumulate:
+            # batch the whole layer's reads behind ONE drain (the
+            # per-leaf read+drain pattern stalls the stream)
+            prevs = [np.empty(n, np.float32) for _, n in ranges]
+            for (off, _n), buf in zip(ranges, prevs):
+                self.grads_store.read(buf, off)
+            self.grads_store.drain()
         ssq = 0.0
-        for (off, n), leaf in zip(self.lr_ranges.ranges(l), leaves):
+        for i, ((off, n), leaf) in enumerate(zip(ranges, leaves)):
             g = np.asarray(jax.device_get(leaf), np.float32).reshape(-1)
-            ssq += float(g @ g)
+            if prevs is not None:
+                g = g + prevs[i]
+            if want_ssq:
+                ssq += float(g @ g)
             self.grads_store.write(g, off)
         self.grads_store.drain()
         return ssq
@@ -266,36 +317,64 @@ class ParamStreamCoordinator:
         return ssq
 
     # ------------------------------------------------------------ train step
+    def _micro_tokens_labels(self, batch, m: int):
+        tokens = jnp.asarray(batch["input_ids"])
+        if tokens.ndim == 3:            # engine stacks [gas, B, T]
+            tokens = tokens[m]
+        labels = batch.get("labels")
+        if labels is not None:
+            labels = jnp.asarray(labels)
+            if labels.ndim == 3:
+                labels = labels[m]
+        else:
+            labels = jnp.concatenate(
+                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)],
+                axis=1)
+        if self._batch_sharding is not None:
+            tokens = jax.device_put(tokens, self._batch_sharding)
+            labels = jax.device_put(labels, self._batch_sharding)
+        return tokens, labels
+
     def train_step(self, batch, rng) -> jax.Array:
         eng = self.engine
-        tokens = jnp.asarray(batch["input_ids"])
-        if tokens.ndim == 3:            # engine stacks [gas=1, B, T]
-            tokens = tokens[0]
-        labels = batch.get("labels")
-        labels = jnp.asarray(labels[0] if labels is not None
-                             and np.ndim(labels) == 3 else labels) \
-            if labels is not None else jnp.concatenate(
-                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
         L = self.lr_ranges.num_layers
-
-        # forward: stream layers, stash inputs
-        x = self._j_embed(self.resident, tokens)
-        stash = [x]
-        for l in range(L):
-            lp = self._fetch_layer(l)
-            x = self._j_layer(lp, x, tokens)
-            stash.append(x)
-
-        loss, dx, dres_head = self._j_head_vjp(self.resident, stash[-1],
-                                               labels)
+        gas = self.gas
+        seed = jnp.float32(1.0 / gas)
+        loss_sum = None
+        dres = None
         ssq = 0.0
-        # backward: stream layers in reverse, recompute-from-stash vjp
-        for l in reversed(range(L)):
-            lp = self._fetch_layer(l)
-            dx, dlp = self._j_layer_vjp(lp, stash[l], tokens, dx)
-            ssq += self._write_layer_grads(l, dlp)
-        dres_embed = self._j_embed_vjp(self.resident, tokens, dx)
-        dres = jax.tree.map(lambda a, b: a + b, dres_head, dres_embed)
+        for m in range(gas):
+            tokens, labels = self._micro_tokens_labels(batch, m)
+            last = m == gas - 1
+            # forward: stream layers, stash inputs
+            x = self._j_embed(self.resident, tokens)
+            stash = [x]
+            for l in range(L):
+                lp = self._fetch_layer(l)
+                x = self._j_layer(lp, x, tokens)
+                stash.append(x)
+
+            loss, dx, dres_head = self._j_head_vjp(
+                self.resident, stash[-1], labels, seed)
+            loss_sum = loss if loss_sum is None else loss_sum + loss
+            # backward: stream layers in reverse, recompute-from-stash
+            # vjp; microbatches past the first ACCUMULATE into grads.bin
+            # (read-modify-write — the reference swapper's grad partition
+            # pass); the norm is computed from the last micro's final
+            # values only
+            for l in reversed(range(L)):
+                lp = self._fetch_layer(l)
+                dx, dlp = self._j_layer_vjp(lp, stash[l], tokens, dx)
+                ssq_l = self._write_layer_grads(l, dlp, accumulate=m > 0,
+                                                want_ssq=last)
+                if last:
+                    ssq += ssq_l
+            dres_embed = self._j_embed_vjp(self.resident, tokens, dx)
+            dres_m = jax.tree.map(lambda a, b: a + b, dres_head,
+                                  dres_embed)
+            dres = dres_m if dres is None else jax.tree.map(
+                lambda a, b: a + b, dres, dres_m)
+        loss = loss_sum / gas
         ssq += self._write_resident_grads(dres)
 
         gnorm = math.sqrt(ssq)
@@ -313,14 +392,7 @@ class ParamStreamCoordinator:
     def eval_step(self, batch) -> jax.Array:
         """Forward-only streamed loss (evaluation for models whose params
         don't fit HBM — same layer streaming as training, no stash/vjp)."""
-        tokens = jnp.asarray(batch["input_ids"])
-        if tokens.ndim == 3:
-            tokens = tokens[0]
-        labels = batch.get("labels")
-        labels = jnp.asarray(labels[0] if labels is not None
-                             and np.ndim(labels) == 3 else labels) \
-            if labels is not None else jnp.concatenate(
-                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        tokens, labels = self._micro_tokens_labels(batch, 0)
         x = self._j_embed(self.resident, tokens)
         for l in range(self.lr_ranges.num_layers):
             x = self._j_layer(self._fetch_layer(l), x, tokens)
@@ -377,6 +449,8 @@ class ParamStreamCoordinator:
                 chunks.append(jnp.asarray(
                     buf.reshape(self.layout.shapes[i])).astype(t.dtype))
             out[key] = jax.tree_util.tree_unflatten(tdef, chunks)
+        if self._repl_sharding is not None:
+            out = jax.device_put(out, self._repl_sharding)
         self.resident = out
 
     # ------------------------------------------------------------ checkpoint

@@ -78,6 +78,35 @@ class ZenFlowCoordinator:
         self.num_blocks = -(-total // self.block)
         self.padded = self.num_blocks * self.block
         self.K = max(1, int(math.ceil(self.num_blocks * float(zf.topk_ratio))))
+        # dp>1 + shard_selection: selection runs PER-SHARD over dp
+        # contiguous ranges of the block space — each data shard picks
+        # its own top-k, the sharded analogue of the reference's
+        # per-rank selection over its Z1/2 gradient partition
+        # (runtime/zenflow/engine_stage3.py). OPT-IN: on this
+        # single-controller runtime every shard's blocks live in one
+        # host, so global top-K costs the same and selects strictly
+        # better; per-shard exists for parity with genuinely
+        # partitioned state (and multi-host futures). The total K
+        # budget is PRESERVED (floor + remainder distribution), so the
+        # knob never inflates device state.
+        self.dp_shards = max(1, int(getattr(engine, "dp_world_size", 1)
+                                    or 1))
+        self._shard_ranges = None
+        if self.dp_shards > 1 and bool(getattr(zf, "shard_selection",
+                                               False)):
+            per = -(-self.num_blocks // self.dp_shards)
+            n_shards = -(-self.num_blocks // per)
+            base, rem = divmod(self.K, n_shards)
+            self._shard_ranges = []
+            k_total = 0
+            for s in range(n_shards):
+                lo = s * per
+                hi = min(self.num_blocks, lo + per)
+                k = min(base + (1 if s < rem else 0), hi - lo)
+                if k > 0:
+                    self._shard_ranges.append((lo, hi, k))
+                    k_total += k
+            self.K = max(1, k_total)
         self.update_interval = 4 if zf.update_interval == "auto" \
             else int(zf.update_interval)
         self.select_interval = 8 * self.update_interval \
@@ -275,11 +304,23 @@ class ZenFlowCoordinator:
         self._scatter_blocks(host.adam.exp_avg, idx, m)
         self._scatter_blocks(host.adam.exp_avg_sq, idx, v)
 
+    def _topk_indices(self, block_sq: np.ndarray) -> np.ndarray:
+        """Global top-K (dp=1) or per-shard top-k over dp contiguous
+        block ranges (dp>1 — see __init__)."""
+        if self._shard_ranges is None:
+            k = min(self.K, self.num_blocks)
+            return np.sort(
+                np.argpartition(-block_sq, k - 1)[:k]).astype(np.int32)
+        parts = []
+        for lo, hi, k in self._shard_ranges:
+            seg = block_sq[lo:hi]
+            parts.append(lo + np.argpartition(-seg, k - 1)[:k])
+        return np.sort(np.concatenate(parts)).astype(np.int32)
+
     def _select(self, block_sq: np.ndarray) -> None:
         """(Re)pick the top-K important blocks and seed device state."""
         self._sync_selection_to_host()
-        k = min(self.K, self.num_blocks)
-        idx = np.sort(np.argpartition(-block_sq, k - 1)[:k]).astype(np.int32)
+        idx = self._topk_indices(block_sq)
         host = self.engine.host_optimizer
         m = self._gather_blocks(host.adam.exp_avg, idx)
         v = self._gather_blocks(host.adam.exp_avg_sq, idx)

@@ -257,3 +257,59 @@ def test_autotune_hbm_calibration(tmp_path, devices, monkeypatch):
     rep2 = _json.load(open(tmp_path / "autotune_results.json"))
     assert not rep2["calibration"]["ok"]
     assert rep2["calibration"]["max_abs_pct_error"] > 20.0
+
+
+def test_elastic_resume_at_new_world_size(tmp_path, devices):
+    """VERDICT r4 #6 end-to-end: train at world 4, SIGTERM-preempt (the
+    agent checkpoints at the step boundary), re-form at world 2 via the
+    elasticity batch solver + universal checkpoint, and training
+    CONTINUES: the resumed engine reproduces the pre-preemption eval
+    loss on a held-out batch and keeps improving on the train batch."""
+    import os as _os
+    from deepspeed_tpu.elasticity.elastic_agent import elastic_resume
+
+    model = gpt2_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB)
+    config = {
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "elasticity": {"enabled": True, "micro_batch_sizes": [1, 2],
+                       "max_train_batch_size": 8, "min_gpus": 1,
+                       "max_gpus": 8},
+        "steps_per_print": 1000,
+    }
+    rng = np.random.default_rng(0)
+    train = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                       dtype=np.int32)}
+    held = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                      dtype=np.int32)}
+
+    # phase 1: world 4
+    eng4, agent4, tag = elastic_resume(model, config, str(tmp_path), 4,
+                                       devices=jax.devices()[:4],
+                                       rng=jax.random.PRNGKey(0))
+    assert tag is None                       # fresh start
+    gas4 = int(eng4.config.gradient_accumulation_steps)
+    losses4 = [float(eng4.train_batch(iter([train] * gas4)))
+               for _ in range(4)]
+    assert losses4[-1] < losses4[0]
+    eval4 = float(eng4.eval_batch(iter([held] * gas4)))
+    _os.kill(_os.getpid(), signal.SIGTERM)   # preemption arrives
+    assert agent4.preemption_pending
+    with pytest.raises(Preempted):
+        agent4.step_boundary()
+    agent4.uninstall()
+
+    # phase 2: re-form at world 2 — batch triple re-solved, params loaded
+    eng2, agent2, tag2 = elastic_resume(model, config, str(tmp_path), 2,
+                                        devices=jax.devices()[:2],
+                                        rng=jax.random.PRNGKey(1))
+    assert tag2 is not None
+    assert eng2.global_steps == eng4.global_steps
+    assert int(eng2.config.train_batch_size) == \
+        int(eng4.config.train_batch_size)    # global batch is invariant
+    gas2 = int(eng2.config.gradient_accumulation_steps)
+    eval2 = float(eng2.eval_batch(iter([held] * gas2)))
+    assert abs(eval2 - eval4) < 2e-4         # same params, new topology
+    cont = [float(eng2.train_batch(iter([train] * gas2)))
+            for _ in range(3)]
+    assert cont[-1] < losses4[-1] + 1e-3     # training continues improving
+    agent2.uninstall()

@@ -531,3 +531,66 @@ def test_param_tier_eval_batch_streams(tmp_path, devices):
                         rng=jax.random.PRNGKey(21))
     got = float(e1.eval_batch(iter([batch])))
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_param_tier_gas_accumulation(tmp_path, devices):
+    """VERDICT r4 #4: the param tier composes with gradient accumulation.
+    GAS=4 over micro-batch 2 must match GAS=1 over the same 8 samples in
+    one batch — mean-gradient semantics, grads accumulated in grads.bin
+    by read-modify-write, global-norm from the final values."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, size=(8, 32), dtype=np.int32)
+
+    def run(tmp, gas):
+        build_mesh(data=1, devices=jax.devices()[:1])
+        cfg = _param_tier_cfg(tmp, device="cpu")
+        cfg["train_micro_batch_size_per_gpu"] = 8 // gas
+        cfg["gradient_accumulation_steps"] = gas
+        eng, *_ = initialize(model=model, config=cfg,
+                             rng=jax.random.PRNGKey(11))
+        losses = []
+        for _ in range(3):
+            micros = [{"input_ids": data[i * (8 // gas):(i + 1) * (8 // gas)]}
+                      for i in range(gas)]
+            losses.append(float(eng.train_batch(iter(micros))))
+        return losses
+
+    l1 = run(tmp_path / "g1", 1)
+    l4 = run(tmp_path / "g4", 4)
+    np.testing.assert_allclose(l4, l1, rtol=3e-4, atol=3e-4)
+
+
+def test_param_tier_dp_mesh(tmp_path, devices):
+    """The param tier under a dp=4 mesh: batch sharded over the data
+    axis, streamed layer weights replicated — loss trajectory matches the
+    single-device tier."""
+    from deepspeed_tpu.models.gpt import gpt2_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import initialize
+
+    model = gpt2_config("tiny", max_seq_len=32, vocab_size=256)
+    rng = np.random.default_rng(7)
+    batches = [{"input_ids": rng.integers(0, 256, size=(8, 32),
+                                          dtype=np.int32)}
+               for _ in range(3)]
+
+    build_mesh(data=1, devices=jax.devices()[:1])
+    e1, *_ = initialize(model=model,
+                        config=_param_tier_cfg(tmp_path / "a",
+                                               device="cpu"),
+                        rng=jax.random.PRNGKey(9))
+    base = [float(e1.train_batch(iter([b]))) for b in batches]
+
+    build_mesh(data=4, devices=jax.devices()[:4])
+    e4, *_ = initialize(model=model,
+                        config=_param_tier_cfg(tmp_path / "b",
+                                               device="cpu"),
+                        rng=jax.random.PRNGKey(9))
+    assert e4._param_stream is not None and e4._param_stream._dp == 4
+    dp = [float(e4.train_batch(iter([b]))) for b in batches]
+    np.testing.assert_allclose(dp, base, rtol=3e-4, atol=3e-4)
