@@ -446,6 +446,13 @@ def resolve_remat_policy(name: Optional[str]):
             jax.checkpoint_policies.save_only_these_names(
                 "attn_kernel_out", "attn_lse", "moe_dispatch",
                 "moe_xs"),
+        # + the MoE GLU pre-activations: backward skips the gate/up/down
+        # kernel re-run at ~2x[R, ffn] bf16 per layer of extra HBM —
+        # measure before enabling at long sequence
+        "save_attn_kernel_moe_glu":
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_kernel_out", "attn_lse", "moe_dispatch",
+                "moe_xs", "moe_glu"),
         # also save post-rope q/k/v: backward skips the QKV projection
         # recompute at +(q_dim+2·kv·Dh)·2B per token of HBM. Helps only
         # when HBM is loose — at the 1.27B/seq2048/b8 bench point the

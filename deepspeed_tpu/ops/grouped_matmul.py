@@ -74,7 +74,7 @@ _VMEM_BUDGET = 12 * 2**20   # double-buffered per-step bytes we allow
 
 def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
                      bm: int) -> Tuple[jax.Array, jax.Array, jax.Array,
-                                       jax.Array, jax.Array]:
+                                       jax.Array, jax.Array, jax.Array]:
     """Counting-sort (token, slot) assignments into a block-aligned layout.
 
     topi/topv: [S, k] expert ids / combine weights. Returns:
@@ -86,14 +86,17 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
       Differentiable w.r.t. ``topv`` (the only float input).
     - ``group_of_tile`` [R_pad // bm] int32 — owning expert per m-tile.
     - ``sizes_padded`` [E] int32 — per-expert row count INCLUDING its
-      alignment padding (consumed by the ragged dw reduction; exact
-      because padding rows have zero activations and gradients).
-
+      alignment padding; the last entry also absorbs the dead tail up
+      to R_pad, whose rows the kernels SKIP and leave unspecified (the
+      ragged dw fallback zero-masks them before reducing).
     - ``pos`` [S, k] int32 — the INVERSE map: row index of each (token,
       slot) assignment in the sorted layout. Having both directions lets
       dispatch AND combine run as pure gathers in both fwd and bwd
       (:func:`gather_rows` / :func:`gather_combine`) — TPU row
       scatter-adds serialize and measured far slower than gathers.
+    - ``live_tiles`` [1] int32 — number of m-tiles containing aligned
+      content; every kernel skips tiles at/past it, so rows beyond
+      ``live_tiles*bm`` are UNSPECIFIED in all produced arrays.
 
     All shapes are static: R_pad = round_up(S·k, bm) + E·bm bounds the
     aligned total for any routing.
@@ -138,8 +141,12 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
     # last group's padded size absorbs the tail tiles beyond the data
     ends = jnp.concatenate([starts[1:], jnp.array([r_pad], jnp.int32)])
     sizes_padded = (ends - starts).astype(jnp.int32)
+    # tiles past the aligned content are pure sentinel — the kernels
+    # skip their compute entirely (R_pad is a worst-case STATIC bound;
+    # the average waste it would cost is ~E*bm/2 rows of matmul)
+    live_tiles = (jnp.sum(aligned) // bm).astype(jnp.int32)[None]
     return (sorted_tok, sorted_w, group_of_tile, sizes_padded,
-            pos.reshape(s, k))
+            pos.reshape(s, k), live_tiles)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -281,26 +288,31 @@ def supported(d: int, f: int) -> bool:
 # monotone in m, so weight blocks refetch only on expert transitions
 # ---------------------------------------------------------------------------
 
-def _gate_up_kernel(g_ref, xs_ref, wg_ref, wi_ref, gate_ref, up_ref):
-    xs = xs_ref[...]
-    gate_ref[...] = jnp.dot(xs, wg_ref[0],
-                            preferred_element_type=jnp.float32
-                            ).astype(gate_ref.dtype)
-    up_ref[...] = jnp.dot(xs, wi_ref[0],
-                          preferred_element_type=jnp.float32
-                          ).astype(up_ref.dtype)
+def _gate_up_kernel(g_ref, lt_ref, xs_ref, wg_ref, wi_ref, gate_ref,
+                    up_ref):
+    @pl.when(pl.program_id(1) < lt_ref[0])
+    def _():
+        xs = xs_ref[...]
+        gate_ref[...] = jnp.dot(xs, wg_ref[0],
+                                preferred_element_type=jnp.float32
+                                ).astype(gate_ref.dtype)
+        up_ref[...] = jnp.dot(xs, wi_ref[0],
+                              preferred_element_type=jnp.float32
+                              ).astype(up_ref.dtype)
 
 
-def _down_kernel(g_ref, gate_ref, up_ref, wo_ref, y_ref):
-    g32 = gate_ref[...].astype(jnp.float32)
-    u32 = up_ref[...].astype(jnp.float32)
-    h = (jax.nn.silu(g32) * u32).astype(wo_ref.dtype)
-    y_ref[...] = jnp.dot(h, wo_ref[0],
-                         preferred_element_type=jnp.float32
-                         ).astype(y_ref.dtype)
+def _down_kernel(g_ref, lt_ref, gate_ref, up_ref, wo_ref, y_ref):
+    @pl.when(pl.program_id(1) < lt_ref[0])
+    def _():
+        g32 = gate_ref[...].astype(jnp.float32)
+        u32 = up_ref[...].astype(jnp.float32)
+        h = (jax.nn.silu(g32) * u32).astype(wo_ref.dtype)
+        y_ref[...] = jnp.dot(h, wo_ref[0],
+                             preferred_element_type=jnp.float32
+                             ).astype(y_ref.dtype)
 
 
-def _dgdu_kernel(g_ref, dy_ref, wo_ref, gate_ref, up_ref,
+def _dgdu_kernel(g_ref, lt_ref, dy_ref, wo_ref, gate_ref, up_ref,
                  dg_ref, du_ref, dwo_ref, acc_o):
     """dH = dY·wo[g]^T (contracted on wo's own [f, d] layout — no
     transposed weight copy in HBM); dgate/dup epilogue; PLUS the dwo
@@ -310,48 +322,57 @@ def _dgdu_kernel(g_ref, dy_ref, wo_ref, gate_ref, up_ref,
     _dw_pair_kernel for why not out_ref)."""
     i = pl.program_id(1)
     nm = pl.num_programs(1)
-    first = jnp.logical_or(
-        i == 0, g_ref[i] != g_ref[jnp.maximum(i - 1, 0)])
+    live = lt_ref[0]
 
-    @pl.when(first)
+    @pl.when(i < live)
     def _():
-        acc_o[...] = jnp.zeros_like(acc_o)
+        first = jnp.logical_or(
+            i == 0, g_ref[i] != g_ref[jnp.maximum(i - 1, 0)])
 
-    dy = dy_ref[...]
-    dh = lax.dot_general(dy, wo_ref[0], (((1,), (1,)), ((), ())),
-                         preferred_element_type=jnp.float32)
-    g32 = gate_ref[...].astype(jnp.float32)
-    u32 = up_ref[...].astype(jnp.float32)
-    sg = jax.nn.sigmoid(g32)
-    silu_g = g32 * sg
-    dsilu = sg * (1.0 + g32 * (1.0 - sg))
-    dg_ref[...] = (dh * u32 * dsilu).astype(dg_ref.dtype)
-    du_ref[...] = (dh * silu_g).astype(du_ref.dtype)
-    h = (silu_g * u32).astype(dy.dtype)
-    acc_o[...] += lax.dot_general(
-        h, dy, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        @pl.when(first)
+        def _():
+            acc_o[...] = jnp.zeros_like(acc_o)
 
-    last = jnp.logical_or(
-        i == nm - 1, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
+        dy = dy_ref[...]
+        dh = lax.dot_general(dy, wo_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        g32 = gate_ref[...].astype(jnp.float32)
+        u32 = up_ref[...].astype(jnp.float32)
+        sg = jax.nn.sigmoid(g32)
+        silu_g = g32 * sg
+        dsilu = sg * (1.0 + g32 * (1.0 - sg))
+        dg_ref[...] = (dh * u32 * dsilu).astype(dg_ref.dtype)
+        du_ref[...] = (dh * silu_g).astype(du_ref.dtype)
+        h = (silu_g * u32).astype(dy.dtype)
+        acc_o[...] += lax.dot_general(
+            h, dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    @pl.when(last)
-    def _():
-        dwo_ref[0] = acc_o[...]
+        # the LAST live tile flushes group E-1 (dead tiles never run)
+        last = jnp.logical_or(
+            i + 1 >= live, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
+
+        @pl.when(last)
+        def _():
+            dwo_ref[0] = acc_o[...]
 
 
-def _dxs_kernel(g_ref, dg_ref, du_ref, wg_ref, wi_ref, dxs_ref):
+def _dxs_kernel(g_ref, lt_ref, dg_ref, du_ref, wg_ref, wi_ref, dxs_ref):
     # contract f on the weights' native [d, f] layout (wg block is
     # (1, bnd, f) — a d-slice), avoiding transposed HBM weight copies
-    acc = lax.dot_general(dg_ref[...], wg_ref[0], (((1,), (1,)), ((), ())),
-                          preferred_element_type=jnp.float32)
-    acc += lax.dot_general(du_ref[...], wi_ref[0], (((1,), (1,)), ((), ())),
-                           preferred_element_type=jnp.float32)
-    dxs_ref[...] = acc.astype(dxs_ref.dtype)
+    @pl.when(pl.program_id(1) < lt_ref[0])
+    def _():
+        acc = lax.dot_general(dg_ref[...], wg_ref[0],
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        acc += lax.dot_general(du_ref[...], wi_ref[0],
+                               (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        dxs_ref[...] = acc.astype(dxs_ref.dtype)
 
 
-def _dw_pair_kernel(g_ref, xs_ref, dg_ref, du_ref, dwg_ref, dwi_ref,
-                    acc_g, acc_i):
+def _dw_pair_kernel(g_ref, lt_ref, xs_ref, dg_ref, du_ref, dwg_ref,
+                    dwi_ref, acc_g, acc_i):
     """Grouped outer products dwg[e] = Σ xs^T dg, dwi[e] = Σ xs^T du.
 
     Grid (n_f_tiles, n_m_tiles), m innermost: g[i] is monotone in i, so
@@ -362,93 +383,100 @@ def _dw_pair_kernel(g_ref, xs_ref, dg_ref, du_ref, dwg_ref, dwi_ref,
     every step (measured 10% MXU efficiency vs ~2ms ideal)."""
     i = pl.program_id(1)
     nm = pl.num_programs(1)
-    first = jnp.logical_or(
-        i == 0, g_ref[i] != g_ref[jnp.maximum(i - 1, 0)])
+    live = lt_ref[0]
 
-    @pl.when(first)
+    @pl.when(i < live)
     def _():
-        acc_g[...] = jnp.zeros_like(acc_g)
-        acc_i[...] = jnp.zeros_like(acc_i)
+        first = jnp.logical_or(
+            i == 0, g_ref[i] != g_ref[jnp.maximum(i - 1, 0)])
 
-    xs = xs_ref[...]
-    acc_g[...] += lax.dot_general(
-        xs, dg_ref[...], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    acc_i[...] += lax.dot_general(
-        xs, du_ref[...], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        @pl.when(first)
+        def _():
+            acc_g[...] = jnp.zeros_like(acc_g)
+            acc_i[...] = jnp.zeros_like(acc_i)
 
-    last = jnp.logical_or(
-        i == nm - 1, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
+        xs = xs_ref[...]
+        acc_g[...] += lax.dot_general(
+            xs, dg_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_i[...] += lax.dot_general(
+            xs, du_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    @pl.when(last)
-    def _():
-        dwg_ref[0] = acc_g[...]
-        dwi_ref[0] = acc_i[...]
+        last = jnp.logical_or(
+            i + 1 >= live, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
+
+        @pl.when(last)
+        def _():
+            dwg_ref[0] = acc_g[...]
+            dwi_ref[0] = acc_i[...]
 
 
-def _dw_pair(xs, dg, du, g_of_tile, num_experts, bm, interpret):
+def _dw_pair(xs, dg, du, g_of_tile, live_tiles, num_experts, bm,
+             interpret):
     """→ (dwg, dwi) [E, d, f] f32."""
     r_pad, d = xs.shape
     f = dg.shape[-1]
     bnf = max(_LANE, min(512, _round_up(f, _LANE)))
     grid = (pl.cdiv(f, bnf), r_pad // bm)
     specs = [
-        pl.BlockSpec((bm, d), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
+        pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
     ]
-    out_specs = [pl.BlockSpec((1, d, bnf), lambda j, i, g: (g[i], 0, j))] * 2
+    out_specs = [pl.BlockSpec((1, d, bnf), lambda j, i, g, lt: (g[i], 0, j))] * 2
     shape = [jax.ShapeDtypeStruct((num_experts, d, f), jnp.float32)] * 2
     scratch = [pltpu.VMEM((d, bnf), jnp.float32)] * 2
     return _grid_call(_dw_pair_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, xs, dg, du, scratch=scratch)
+                      interpret, g_of_tile, live_tiles, xs, dg, du,
+                      scratch=scratch)
 
 
 def _grid_call(kernel, grid, in_specs, out_specs, out_shape, interpret,
-               group_of_tile, *args, scratch=None):
+               group_of_tile, live_tiles, *args, scratch=None):
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1, grid=grid,
+            num_scalar_prefetch=2, grid=grid,
             in_specs=in_specs, out_specs=out_specs,
             scratch_shapes=scratch or []),
         out_shape=out_shape,
         interpret=interpret,
-    )(group_of_tile, *args)
+    )(group_of_tile, live_tiles, *args)
 
 
-def _gate_up(xs, wg, wi, g_of_tile, bm, bnf, interpret):
+def _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf, interpret):
     r_pad, d = xs.shape
     f = wg.shape[-1]
     grid = (pl.cdiv(f, bnf), r_pad // bm)
     specs = [
-        pl.BlockSpec((bm, d), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((1, d, bnf), lambda j, i, g: (g[i], 0, j)),
-        pl.BlockSpec((1, d, bnf), lambda j, i, g: (g[i], 0, j)),
+        pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, d, bnf), lambda j, i, g, lt: (g[i], 0, j)),
+        pl.BlockSpec((1, d, bnf), lambda j, i, g, lt: (g[i], 0, j)),
     ]
-    out_specs = [pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j))] * 2
+    out_specs = [pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j))] * 2
     shape = [jax.ShapeDtypeStruct((r_pad, f), xs.dtype)] * 2
     return _grid_call(_gate_up_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, xs, wg, wi)
+                      interpret, g_of_tile, live_tiles, xs, wg, wi)
 
 
-def _down(gate, up, wo, g_of_tile, bm, bnd, interpret):
+def _down(gate, up, wo, g_of_tile, live_tiles, bm, bnd, interpret):
     r_pad, f = gate.shape
     d = wo.shape[-1]
     grid = (pl.cdiv(d, bnd), r_pad // bm)
     specs = [
-        pl.BlockSpec((bm, f), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((bm, f), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((1, f, bnd), lambda j, i, g: (g[i], 0, j)),
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, f, bnd), lambda j, i, g, lt: (g[i], 0, j)),
     ]
-    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g: (i, j))
+    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g, lt: (i, j))
     shape = jax.ShapeDtypeStruct((r_pad, d), gate.dtype)
     return _grid_call(_down_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, gate, up, wo)
+                      interpret, g_of_tile, live_tiles, gate, up, wo)
 
 
-def _dgdu(dy, wo, gate, up, g_of_tile, num_experts, bm, bnf, interpret):
+def _dgdu(dy, wo, gate, up, g_of_tile, live_tiles, num_experts, bm,
+          bnf, interpret):
     """→ (dg, du [R_pad, f], dwo [E, f, d] f32). Takes wo in its native
     [E, f, d] layout (f-slice blocks). The dwo accumulator block
     (1, bnf, d) f32 shares the step, so bnf is capped at 512 here to
@@ -458,26 +486,26 @@ def _dgdu(dy, wo, gate, up, g_of_tile, num_experts, bm, bnf, interpret):
     bnf = min(bnf, 512)
     grid = (pl.cdiv(f, bnf), r_pad // bm)
     specs = [
-        pl.BlockSpec((bm, d), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((1, bnf, d), lambda j, i, g: (g[i], j, 0)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
+        pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
     ]
     out_specs = [
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g: (i, j)),
-        pl.BlockSpec((1, bnf, d), lambda j, i, g: (g[i], j, 0)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
     ]
     shape = [jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
              jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
              jax.ShapeDtypeStruct((num_experts, f, d), jnp.float32)]
     scratch = [pltpu.VMEM((bnf, d), jnp.float32)]
     return _grid_call(_dgdu_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, dy, wo, gate, up,
+                      interpret, g_of_tile, live_tiles, dy, wo, gate, up,
                       scratch=scratch)
 
 
-def _dxs(dg, du, wg, wi, g_of_tile, bm, bnd, interpret):
+def _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd, interpret):
     """dxs = dg·wg^T + du·wi^T with the weights in their native [E, d, f]
     layout (d-slice blocks, contraction on f)."""
     r_pad, f = dg.shape
@@ -487,15 +515,15 @@ def _dxs(dg, du, wg, wi, g_of_tile, bm, bnd, interpret):
     bnd = max(_LANE, bnd // 2)
     grid = (pl.cdiv(d, bnd), r_pad // bm)
     specs = [
-        pl.BlockSpec((bm, f), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((bm, f), lambda j, i, g: (i, 0)),
-        pl.BlockSpec((1, bnd, f), lambda j, i, g: (g[i], j, 0)),
-        pl.BlockSpec((1, bnd, f), lambda j, i, g: (g[i], j, 0)),
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, bnd, f), lambda j, i, g, lt: (g[i], j, 0)),
+        pl.BlockSpec((1, bnd, f), lambda j, i, g, lt: (g[i], j, 0)),
     ]
-    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g: (i, j))
+    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g, lt: (i, j))
     shape = jax.ShapeDtypeStruct((r_pad, d), dg.dtype)
     return _grid_call(_dxs_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, dg, du, wg, wi)
+                      interpret, g_of_tile, live_tiles, dg, du, wg, wi)
 
 
 # ---------------------------------------------------------------------------
@@ -524,39 +552,68 @@ def _dw_ragged(lhs, grad, sizes_padded, num_experts):
 
 @functools.lru_cache(maxsize=None)
 def _build_ffn(bm: int, bnf: int, bnd: int, interpret: bool):
-    """custom_vjp'd (xs, wg, wi, wo, group_of_tile, sizes_padded) -> Y."""
+    """custom_vjp'd (xs, wg, wi, wo, group_of_tile, sizes_padded,
+    live_tiles) -> Y. Rows at/past ``live_tiles * bm`` are UNSPECIFIED
+    in every produced array (the kernels skip those tiles outright) —
+    consumers must address rows through the dispatch maps only."""
 
     @jax.custom_vjp
-    def ffn(xs, wg, wi, wo, g_of_tile, sizes_padded):
-        gate, up = _gate_up(xs, wg, wi, g_of_tile, bm, bnf, interpret)
-        return _down(gate, up, wo, g_of_tile, bm, bnd, interpret)
+    def ffn(xs, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
+        gate, up = _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf,
+                            interpret)
+        return _down(gate, up, wo, g_of_tile, live_tiles, bm, bnd,
+                     interpret)
 
-    def fwd(xs, wg, wi, wo, g_of_tile, sizes_padded):
-        gate, up = _gate_up(xs, wg, wi, g_of_tile, bm, bnf, interpret)
-        y = _down(gate, up, wo, g_of_tile, bm, bnd, interpret)
-        return y, (xs, gate, up, wg, wi, wo, g_of_tile, sizes_padded)
+    def fwd(xs, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
+        from jax.ad_checkpoint import checkpoint_name
+        gate, up = _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf,
+                            interpret)
+        # named so remat policies can SAVE the GLU pre-activations:
+        # without them the layer backward re-runs the gate/up/down
+        # kernels (3 of the FFN's 12 executed matmul units) just to
+        # rebuild these residuals. ~2x[R, ffn] bf16 per layer — a
+        # policy opt-in, not a default
+        gate = checkpoint_name(gate, "moe_glu")
+        up = checkpoint_name(up, "moe_glu")
+        y = _down(gate, up, wo, g_of_tile, live_tiles, bm, bnd, interpret)
+        return y, (xs, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+                   live_tiles)
 
     def bwd(res, dy):
-        xs, gate, up, wg, wi, wo, g_of_tile, sizes_padded = res
+        (xs, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+         live_tiles) = res
         e = wg.shape[0]
-        dg, du, dwo32 = _dgdu(dy, wo, gate, up, g_of_tile, e, bm, bnf,
-                              interpret)
-        dxs = _dxs(dg, du, wg, wi, g_of_tile, bm, bnd, interpret)
+        dg, du, dwo32 = _dgdu(dy, wo, gate, up, g_of_tile, live_tiles,
+                              e, bm, bnf, interpret)
+        dxs = _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd,
+                   interpret)
         dw_mode = os.environ.get("DSTPU_GMM_DW", "pallas")
         if dw_mode == "pallas":
-            dwg, dwi = _dw_pair(xs, dg, du, g_of_tile, e, bm, interpret)
+            dwg, dwi = _dw_pair(xs, dg, du, g_of_tile, live_tiles, e,
+                                bm, interpret)
             dwg = dwg.astype(wg.dtype)
             dwi = dwi.astype(wi.dtype)
             dwo = dwo32.astype(wo.dtype)
         else:   # 'ragged' (XLA fallback) / 'zero' (bench diagnostic)
-            dwg = _dw_ragged(xs, dg, sizes_padded, e)
-            dwi = _dw_ragged(xs, du, sizes_padded, e)
-            hidden = (jax.nn.silu(gate.astype(jnp.float32))
-                      * up.astype(jnp.float32)).astype(gate.dtype)
+            # the skipped dead-tail tiles leave dg/du/gate/up
+            # UNINITIALIZED there, and sizes_padded[E-1] absorbs that
+            # tail — zero it before the ragged reduction or 0*NaN
+            # poisons the last expert's weight grads
+            row = jnp.arange(xs.shape[0], dtype=jnp.int32)[:, None]
+            alive = row < live_tiles[0] * bm
+            dg_z = jnp.where(alive, dg, 0)
+            du_z = jnp.where(alive, du, 0)
+            dwg = _dw_ragged(xs, dg_z, sizes_padded, e)
+            dwi = _dw_ragged(xs, du_z, sizes_padded, e)
+            hidden = jnp.where(
+                alive,
+                (jax.nn.silu(gate.astype(jnp.float32))
+                 * up.astype(jnp.float32)).astype(gate.dtype), 0)
             dwo = _dw_ragged(hidden, dy, sizes_padded, e)
         return (dxs, dwg, dwi, dwo,
                 np.zeros(g_of_tile.shape, jax.dtypes.float0),
-                np.zeros(sizes_padded.shape, jax.dtypes.float0))
+                np.zeros(sizes_padded.shape, jax.dtypes.float0),
+                np.zeros(live_tiles.shape, jax.dtypes.float0))
 
     ffn.defvjp(fwd, bwd)
     return ffn
@@ -564,8 +621,9 @@ def _build_ffn(bm: int, bnf: int, bnd: int, interpret: bool):
 
 def grouped_glu_ffn(xs: jax.Array, wg: jax.Array, wi: jax.Array,
                     wo: jax.Array, group_of_tile: jax.Array,
-                    sizes_padded: jax.Array, *, bm: int, bnf: int,
-                    bnd: int, interpret: bool = False) -> jax.Array:
+                    sizes_padded: jax.Array, live_tiles: jax.Array, *,
+                    bm: int, bnf: int, bnd: int,
+                    interpret: bool = False) -> jax.Array:
     """Grouped SwiGLU FFN over a block-aligned sorted row layout.
 
     xs [R_pad, d] (rows sorted by expert, padding rows zero), wg/wi
@@ -573,4 +631,4 @@ def grouped_glu_ffn(xs: jax.Array, wg: jax.Array, wi: jax.Array,
     combine weights so the gate-weight gradient stays in autodiff-land).
     """
     return _build_ffn(bm, bnf, bnd, interpret)(
-        xs, wg, wi, wo, group_of_tile, sizes_padded)
+        xs, wg, wi, wo, group_of_tile, sizes_padded, live_tiles)

@@ -172,7 +172,7 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
         # cast combine weights to compute dtype BEFORE the dispatch
         # scatter: values are identical to casting after the gather (a
         # scatter moves bits), but the scatter payload halves
-        tok, w, g_of_tile, sizes, pos = checkpoint_name(
+        tok, w, g_of_tile, sizes, pos, live = checkpoint_name(
             gmm.aligned_dispatch(topi, topv.astype(xf.dtype), e, bm),
             "moe_dispatch")
         xf1 = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
@@ -182,7 +182,7 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
         xs = checkpoint_name(gmm.gather_rows(xf1, tok, pos), "moe_xs")
         y = gmm.grouped_glu_ffn(
             xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
-            p["wo"].astype(xs.dtype), g_of_tile, sizes,
+            p["wo"].astype(xs.dtype), g_of_tile, sizes, live,
             bm=bm, bnf=bnf, bnd=bnd,
             interpret=jax.default_backend() != "tpu")
         # combine = gather over the inverse map (no token scatter-add)

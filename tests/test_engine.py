@@ -326,6 +326,7 @@ def test_save_attn_kernel_remat_policy(devices):
         0, 256, size=(8, 32)), np.int32)}
     losses = {}
     for policy in ("save_attn_out", "save_attn_kernel",
+                   "save_attn_kernel_moe_glu",
                    "offload_save_attn_kernel",
                    "offload_save_attn_kernel_host"):
         build_mesh(data=8)
