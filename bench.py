@@ -349,10 +349,12 @@ def main() -> None:
                 "traffic), not the design — ZenFlow/offload validation "
                 "lives in the CPU-mesh tests; host dispatch costs "
                 "~20ms/call, so serving loops are measured with "
-                "device-resident fused chunks; seq 256K single-chip "
+                "device-resident fused chunks; seq 192K+ single-chip "
                 "crashes the remote TPU-VM worker (host pinned-memory "
-                "pressure) — 128K is the driver-visible FPDT point, "
-                "192K the smoke ceiling"),
+                "pressure) regardless of remat policy or model size — "
+                "128K is the driver-visible FPDT point and this "
+                "runtime's single-chip ceiling (with SP=8 that local "
+                "length is 1M tokens of global context)"),
         }
     print(json.dumps(result))
 
