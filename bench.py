@@ -136,8 +136,15 @@ def moe_main(args) -> None:
         "bf16": {"enabled": bool(on_tpu)},
         "gradient_clipping": 1.0,
         "moe": {"impl": os.environ.get("DSTPU_BENCH_MOE_IMPL", "dropless")},
+        # save_attn_kernel_moe_glu (backward re-runs ZERO MoE kernels —
+        # verified 6→5 pallas calls in the compiled HLO) measured ~1pt
+        # SLOWER than letting the gate_up kernel re-run: the 4.7GB of
+        # stacked [L,R,f] GLU residuals cost more in scan traffic than
+        # the 2 recomputed matmul units. Re-measure per geometry.
         "activation_checkpointing": {
-            "policy": "save_attn_kernel" if on_tpu else "none"},
+            "policy": os.environ.get(
+                "DSTPU_BENCH_MOE_POLICY",
+                "save_attn_kernel") if on_tpu else "none"},
         "ce_logits_dtype": "bf16" if on_tpu else None,
         "chunked_ce_budget_mb": 256 if on_tpu else None,
         "steps_per_print": 1000,

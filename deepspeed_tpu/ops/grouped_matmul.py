@@ -44,6 +44,17 @@ directions, so dispatch and combine are pure gathers in fwd and bwd
 (:func:`gather_rows` / :func:`gather_combine`) — TPU row scatter-adds
 serialize per index.
 
+**Fused combine weights (r5).** Passing ``w`` to :func:`grouped_glu_ffn`
+applies the per-row combine weights INSIDE the down kernel and computes
+their gradient (``dw[r] = dZ[r]·y[r]``, the router's training signal)
+inside the dgdu kernel as a per-f-tile ``rowsum(dh·h)`` — both already
+have the operands streaming through VMEM. The combine then collapses to
+the residual-free :func:`gather_sum`: no ``[R,d]`` elementwise scale in
+fwd or bwd, no separate ``[R,d]`` row-dot for ``dw``, and — because the
+FFN output is no longer anyone's VJP residual — remat policies that save
+``moe_glu`` re-run NOTHING of the FFN in backward (12 → 9 executed
+matmul units per layer under ``save_attn_kernel_moe_glu``).
+
 Parity is asserted against a per-expert einsum reference in
 tests/test_grouped_matmul.py; integration (full dropless layer fwd+bwd vs
 the ragged_dot path, including router gradients) in tests/test_moe.py.
@@ -62,7 +73,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["aligned_dispatch", "grouped_glu_ffn", "gather_rows",
-           "gather_combine", "supported", "pick_blocks"]
+           "gather_combine", "gather_sum", "supported", "pick_blocks"]
 
 _LANE = 128
 _VMEM_BUDGET = 12 * 2**20   # double-buffered per-step bytes we allow
@@ -77,7 +88,9 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
                                        jax.Array, jax.Array, jax.Array]:
     """Counting-sort (token, slot) assignments into a block-aligned layout.
 
-    topi/topv: [S, k] expert ids / combine weights. Returns:
+    topi/topv: [k, S] expert ids / combine weights, SLOT-MAJOR (the
+    whole routing chain runs transposed — tokens on lanes; see
+    ``topk_gates_t``). Returns:
 
     - ``sorted_tok`` [R_pad] int32 — source token for each sorted row;
       padding rows hold the sentinel ``S`` (callers gather from an
@@ -89,11 +102,12 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
       alignment padding; the last entry also absorbs the dead tail up
       to R_pad, whose rows the kernels SKIP and leave unspecified (the
       ragged dw fallback zero-masks them before reducing).
-    - ``pos`` [S, k] int32 — the INVERSE map: row index of each (token,
-      slot) assignment in the sorted layout. Having both directions lets
-      dispatch AND combine run as pure gathers in both fwd and bwd
+    - ``pos`` [k, S] int32 — the INVERSE map: row index of each (slot,
+      token) assignment in the sorted layout. Having both directions
+      lets dispatch AND combine run as pure gathers in both fwd and bwd
       (:func:`gather_rows` / :func:`gather_combine`) — TPU row
       scatter-adds serialize and measured far slower than gathers.
+      ``pos[slot]`` is a clean [S] lanes-major vector per slot.
     - ``live_tiles`` [1] int32 — number of m-tiles containing aligned
       content; every kernel skips tiles at/past it, so rows beyond
       ``live_tiles*bm`` are UNSPECIFIED in all produced arrays.
@@ -101,11 +115,11 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
     All shapes are static: R_pad = round_up(S·k, bm) + E·bm bounds the
     aligned total for any routing.
     """
-    s, k = topi.shape
+    k, s = topi.shape
     r0 = s * k
     e = num_experts
     r_pad = _round_up(r0, bm) + e * bm
-    flat_e = topi.reshape(-1).astype(jnp.int32)               # [R0]
+    flat_e = topi.reshape(-1).astype(jnp.int32)      # [R0] slot-major
     # transposed [E, R0] histogram: E lives on SUBLANES and R0 on lanes,
     # so the running-count cumsum vectorizes over full 128-lane tiles —
     # the [R0, E] orientation used 8 of 128 lanes and profiled at
@@ -126,7 +140,7 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
     rank = jnp.take_along_axis(cum_t, flat_e[None, :],
                                axis=0)[0] - 1                 # [R0]
     pos = starts[flat_e] + rank                               # [R0]
-    tok = (jnp.arange(r0, dtype=jnp.int32) // k)              # source token
+    tok = (jnp.arange(r0, dtype=jnp.int32) % s)               # source token
     # pos is a permutation into [0, r_pad) — tell XLA (unique + in
     # bounds) so the TPU scatter lowering can skip the serializing
     # duplicate-combine path
@@ -146,7 +160,7 @@ def aligned_dispatch(topi: jax.Array, topv: jax.Array, num_experts: int,
     # the average waste it would cost is ~E*bm/2 rows of matmul)
     live_tiles = (jnp.sum(aligned) // bm).astype(jnp.int32)[None]
     return (sorted_tok, sorted_w, group_of_tile, sizes_padded,
-            pos.reshape(s, k), live_tiles)
+            pos.reshape(k, s), live_tiles)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -172,8 +186,8 @@ def gather_rows(xf1: jax.Array, sorted_tok: jax.Array,
     """xs[r] = xf1[sorted_tok[r]] — dispatch gather into sorted order.
 
     xf1 [S+1, d] (a zero sentinel row appended at index S), sorted_tok
-    [R_pad], pos [S, k]. The VJP accumulates via the inverse gather:
-    dxf1[t] = Σ_slot dxs[pos[t, slot]]; the sentinel row's gradient is
+    [R_pad], pos [k, S]. The VJP accumulates via the inverse gather:
+    dxf1[t] = Σ_slot dxs[pos[slot, t]]; the sentinel row's gradient is
     dropped (callers append a constant zero row, whose gradient the
     enclosing concat discards anyway).
     """
@@ -186,12 +200,12 @@ def _gather_rows_fwd(xf1, sorted_tok, pos):
 
 def _gather_rows_bwd(res, dxs):
     pos, tok_shape = res
-    # k unrolled gathers + adds, NOT dxs[pos].sum(1): the [S, k, d]
-    # intermediate tiles as T(2,128) (k=2 sublanes) and its reduce was
-    # one of the profiled per-layer hot spots
-    dxf = dxs[pos[:, 0]]
-    for slot in range(1, pos.shape[1]):
-        dxf = dxf + dxs[pos[:, slot]]
+    # k unrolled gathers + adds, NOT dxs[pos].sum(0): the [k, S, d]
+    # intermediate and its reduce was one of the profiled per-layer
+    # hot spots
+    dxf = dxs[pos[0]]
+    for slot in range(1, pos.shape[0]):
+        dxf = dxf + dxs[pos[slot]]
     dxf1 = jnp.concatenate([dxf, jnp.zeros((1, dxs.shape[-1]), dxs.dtype)])
     return (dxf1, np.zeros(tok_shape, jax.dtypes.float0),
             np.zeros(pos.shape, jax.dtypes.float0))
@@ -203,10 +217,10 @@ gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 @jax.custom_vjp
 def gather_combine(y: jax.Array, w: jax.Array, sorted_tok: jax.Array,
                    pos: jax.Array) -> jax.Array:
-    """out[t] = Σ_slot w[pos[t,slot]] · y[pos[t,slot]] — the combine as a
+    """out[t] = Σ_slot w[pos[slot,t]] · y[pos[slot,t]] — the combine as a
     gather over the inverse map instead of a scatter-add over tokens.
 
-    y [R_pad, d], w [R_pad] (zero on padding rows), pos [S, k] →
+    y [R_pad, d], w [R_pad] (zero on padding rows), pos [k, S] →
     out [S, d]. Differentiable in y AND w (w carries the router's gate
     values, so its gradient trains the router).
     """
@@ -216,9 +230,9 @@ def gather_combine(y: jax.Array, w: jax.Array, sorted_tok: jax.Array,
 def _combine_impl(y, w, pos):
     # k unrolled gathers + adds (see _gather_rows_bwd for why)
     yw = y * w[:, None].astype(y.dtype)
-    out = yw[pos[:, 0]]
-    for slot in range(1, pos.shape[1]):
-        out = out + yw[pos[:, slot]]
+    out = yw[pos[0]]
+    for slot in range(1, pos.shape[0]):
+        out = out + yw[pos[slot]]
     return out
 
 
@@ -265,7 +279,12 @@ def pick_blocks(d: int, f: int, itemsize: int = 2
     dxs kernel derives its own narrower n-block (two full-K weight
     blocks in flight) — see :func:`_dxs`.
     """
-    bnf = _block(f, int(os.environ.get("DSTPU_GMM_BNF", 1024)))
+    # defaults from the r5 on-chip sweep (1B/8e bench geometry, v5e):
+    # bnf 256 < 512 < 1024 < 1408 (13.5/13.9/15.5/17.6 ms per layer
+    # fwd+bwd) — small f-tiles re-read xs more but pipeline better and
+    # shrink the dgdu/dw accumulators; bm > 256 fails to compile and
+    # 128 only wins when paired with the losing bnf=1024
+    bnf = _block(f, int(os.environ.get("DSTPU_GMM_BNF", 256)))
     bnd = _block(d, int(os.environ.get("DSTPU_GMM_BND", 512)))
     bm = int(os.environ.get("DSTPU_GMM_BM", 0)) or 256
     # dominant per-step footprint (gate_up kernel): xs + 2 weight blocks +
@@ -312,6 +331,20 @@ def _down_kernel(g_ref, lt_ref, gate_ref, up_ref, wo_ref, y_ref):
                              ).astype(y_ref.dtype)
 
 
+def _down_w_kernel(g_ref, lt_ref, gate_ref, up_ref, w_ref, wo_ref, z_ref):
+    """Down projection with the per-row combine weight fused into the
+    epilogue: Z = diag(w)·(silu(gate)·up)·wo[g]. ``w_ref`` is a
+    lanes-major (1, bm) tile row (the flash kernels' lse layout)."""
+    @pl.when(pl.program_id(1) < lt_ref[0])
+    def _():
+        g32 = gate_ref[...].astype(jnp.float32)
+        u32 = up_ref[...].astype(jnp.float32)
+        h = (jax.nn.silu(g32) * u32).astype(wo_ref.dtype)
+        y = jnp.dot(h, wo_ref[0], preferred_element_type=jnp.float32)
+        w = w_ref[0, 0].astype(jnp.float32)                  # [bm] lanes
+        z_ref[...] = (y * w[:, None]).astype(z_ref.dtype)
+
+
 def _dgdu_kernel(g_ref, lt_ref, dy_ref, wo_ref, gate_ref, up_ref,
                  dg_ref, du_ref, dwo_ref, acc_o):
     """dH = dY·wo[g]^T (contracted on wo's own [f, d] layout — no
@@ -349,6 +382,71 @@ def _dgdu_kernel(g_ref, lt_ref, dy_ref, wo_ref, gate_ref, up_ref,
             preferred_element_type=jnp.float32)
 
         # the LAST live tile flushes group E-1 (dead tiles never run)
+        last = jnp.logical_or(
+            i + 1 >= live, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
+
+        @pl.when(last)
+        def _():
+            dwo_ref[0] = acc_o[...]
+
+
+def _dgdu_w_kernel(g_ref, lt_ref, dz_ref, w_ref, wo_ref, gate_ref,
+                   up_ref, dg_ref, du_ref, dwo_ref, dwp_ref, acc_o, *,
+                   f_total, bnf):
+    """The scaled-FFN backward tile: upstream dZ arrives UNSCALED by the
+    combine weights (the combine is a plain gather-sum), so this kernel
+    additionally produces the combine-weight gradient
+
+        dw[r] = dZ[r]·y[r] = Σ_f (dZ·wo[g]^T)[r,f] · h[r,f]
+
+    as per-f-tile partials (``dwp_ref`` [1,1,bm]; summed over f-tiles by
+    the caller) — dh and h are already live in VMEM, so the row-dot that
+    used to re-sweep [R,d] from HBM costs one masked VPU reduce here.
+    dgate/dup/dwo pick up the per-row w factor (d(h@wo) = w ⊙ dZ)."""
+    i = pl.program_id(1)
+    nm = pl.num_programs(1)
+    j = pl.program_id(0)
+    live = lt_ref[0]
+
+    @pl.when(i < live)
+    def _():
+        first = jnp.logical_or(
+            i == 0, g_ref[i] != g_ref[jnp.maximum(i - 1, 0)])
+
+        @pl.when(first)
+        def _():
+            acc_o[...] = jnp.zeros_like(acc_o)
+
+        dz = dz_ref[...]
+        w32 = w_ref[0, 0].astype(jnp.float32)                # [bm] lanes
+        dh = lax.dot_general(dz, wo_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        g32 = gate_ref[...].astype(jnp.float32)
+        u32 = up_ref[...].astype(jnp.float32)
+        sg = jax.nn.sigmoid(g32)
+        silu_g = g32 * sg
+        h32 = silu_g * u32
+        # the last f tile is partial when bnf ∤ f — its out-of-range
+        # lanes hold unspecified loads. Harmless for dg/du/dwo (their
+        # writes are masked the same way) but the dw reduce SUMS over
+        # lanes, so mask before reducing.
+        if f_total % bnf:
+            col = lax.broadcasted_iota(jnp.int32, h32.shape, 1)
+            valid = (col + j * bnf) < f_total
+            prod = jnp.where(valid, dh * h32, 0.0)
+        else:
+            prod = dh * h32
+        dwp_ref[0, 0, 0, :] = jnp.sum(prod, axis=1)
+        dhw = dh * w32[:, None]
+        dsilu = sg * (1.0 + g32 * (1.0 - sg))
+        dg_ref[...] = (dhw * u32 * dsilu).astype(dg_ref.dtype)
+        du_ref[...] = (dhw * silu_g).astype(du_ref.dtype)
+        h = h32.astype(dz.dtype)
+        dzw = (dz.astype(jnp.float32) * w32[:, None]).astype(dz.dtype)
+        acc_o[...] += lax.dot_general(
+            h, dzw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
         last = jnp.logical_or(
             i + 1 >= live, g_ref[i] != g_ref[jnp.minimum(i + 1, nm - 1)])
 
@@ -473,6 +571,58 @@ def _down(gate, up, wo, g_of_tile, live_tiles, bm, bnd, interpret):
     shape = jax.ShapeDtypeStruct((r_pad, d), gate.dtype)
     return _grid_call(_down_kernel, grid, specs, out_specs, shape,
                       interpret, g_of_tile, live_tiles, gate, up, wo)
+
+
+def _down_w(gate, up, w2, wo, g_of_tile, live_tiles, bm, bnd, interpret):
+    r_pad, f = gate.shape
+    d = wo.shape[-1]
+    grid = (pl.cdiv(d, bnd), r_pad // bm)
+    specs = [
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        # [nm, 1, bm] lanes-major: the TPU lowering requires the last
+        # two block dims be (unit-or-full, 128-multiple)
+        pl.BlockSpec((1, 1, bm), lambda j, i, g, lt: (i, 0, 0)),
+        pl.BlockSpec((1, f, bnd), lambda j, i, g, lt: (g[i], 0, j)),
+    ]
+    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g, lt: (i, j))
+    shape = jax.ShapeDtypeStruct((r_pad, d), gate.dtype)
+    return _grid_call(_down_w_kernel, grid, specs, out_specs, shape,
+                      interpret, g_of_tile, live_tiles, gate, up, w2, wo)
+
+
+def _dgdu_w(dz, w2, wo, gate, up, g_of_tile, live_tiles, num_experts,
+            bm, bnf, interpret):
+    """→ (dg, du [R_pad, f], dwo [E, f, d] f32, dwp [n_f, nm, bm] f32).
+    The caller sums dwp over its leading axis for dw."""
+    r_pad, d = dz.shape
+    f = gate.shape[-1]
+    bnf = min(bnf, 512)
+    nf = pl.cdiv(f, bnf)
+    nm = r_pad // bm
+    grid = (nf, nm)
+    specs = [
+        pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, 1, bm), lambda j, i, g, lt: (i, 0, 0)),
+        pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+    ]
+    out_specs = [
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
+        pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
+        pl.BlockSpec((1, 1, 1, bm), lambda j, i, g, lt: (j, i, 0, 0)),
+    ]
+    shape = [jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
+             jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
+             jax.ShapeDtypeStruct((num_experts, f, d), jnp.float32),
+             jax.ShapeDtypeStruct((nf, nm, 1, bm), jnp.float32)]
+    scratch = [pltpu.VMEM((bnf, d), jnp.float32)]
+    kernel = functools.partial(_dgdu_w_kernel, f_total=f, bnf=bnf)
+    return _grid_call(kernel, grid, specs, out_specs, shape,
+                      interpret, g_of_tile, live_tiles, dz, w2, wo, gate,
+                      up, scratch=scratch)
 
 
 def _dgdu(dy, wo, gate, up, g_of_tile, live_tiles, num_experts, bm,
@@ -619,16 +769,137 @@ def _build_ffn(bm: int, bnf: int, bnd: int, interpret: bool):
     return ffn
 
 
+@functools.lru_cache(maxsize=None)
+def _build_ffn_w(bm: int, bnf: int, bnd: int, interpret: bool):
+    """Scaled variant: (xs, w2, wg, wi, wo, meta…) -> Z with the per-row
+    combine weights applied in the down kernel and their gradient
+    computed in the dgdu kernel (see :func:`_dgdu_w_kernel`). Z is NOT a
+    VJP residual of anything downstream — the combine is the
+    residual-free :func:`gather_sum` — so saving ``moe_glu`` (+ the
+    dispatch metadata and ``moe_xs``) makes the layer backward re-run
+    zero kernels under remat."""
+
+    @jax.custom_vjp
+    def ffn(xs, w2, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
+        gate, up = _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf,
+                            interpret)
+        return _down_w(gate, up, w2, wo, g_of_tile, live_tiles, bm, bnd,
+                       interpret)
+
+    def fwd(xs, w2, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
+        from jax.ad_checkpoint import checkpoint_name
+        gate, up = _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf,
+                            interpret)
+        gate = checkpoint_name(gate, "moe_glu")
+        up = checkpoint_name(up, "moe_glu")
+        z = _down_w(gate, up, w2, wo, g_of_tile, live_tiles, bm, bnd,
+                    interpret)
+        return z, (xs, w2, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+                   live_tiles)
+
+    def bwd(res, dz):
+        (xs, w2, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+         live_tiles) = res
+        e = wg.shape[0]
+        dg, du, dwo32, dwp = _dgdu_w(dz, w2, wo, gate, up, g_of_tile,
+                                     live_tiles, e, bm, bnf, interpret)
+        if os.environ.get("DSTPU_GMM_DCOMBINE") == "zero":
+            # BENCH-ONLY diagnostic: drop the router's training signal
+            # to expose the combine-weight-grad cost
+            dw2 = jnp.zeros_like(w2)
+        else:
+            dw2 = jnp.sum(dwp, axis=0).astype(w2.dtype)   # [nm, 1, bm]
+        dxs = _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd,
+                   interpret)
+        dw_mode = os.environ.get("DSTPU_GMM_DW", "pallas")
+        if dw_mode == "pallas":
+            dwg, dwi = _dw_pair(xs, dg, du, g_of_tile, live_tiles, e,
+                                bm, interpret)
+            dwg = dwg.astype(wg.dtype)
+            dwi = dwi.astype(wi.dtype)
+            dwo = dwo32.astype(wo.dtype)
+        else:   # 'ragged' (XLA fallback) / 'zero' (bench diagnostic)
+            row = jnp.arange(xs.shape[0], dtype=jnp.int32)[:, None]
+            alive = row < live_tiles[0] * bm
+            dg_z = jnp.where(alive, dg, 0)
+            du_z = jnp.where(alive, du, 0)
+            dwg = _dw_ragged(xs, dg_z, sizes_padded, e)
+            dwi = _dw_ragged(xs, du_z, sizes_padded, e)
+            hidden = jnp.where(
+                alive,
+                (jax.nn.silu(gate.astype(jnp.float32))
+                 * up.astype(jnp.float32)).astype(gate.dtype), 0)
+            # d(h·wo) = w ⊙ dZ under the fused scaling
+            dzw = jnp.where(
+                alive,
+                dz * w2.reshape(-1, 1).astype(dz.dtype), 0)
+            dwo = _dw_ragged(hidden, dzw, sizes_padded, e)
+        return (dxs, dw2, dwg, dwi, dwo,
+                np.zeros(g_of_tile.shape, jax.dtypes.float0),
+                np.zeros(sizes_padded.shape, jax.dtypes.float0),
+                np.zeros(live_tiles.shape, jax.dtypes.float0))
+
+    ffn.defvjp(fwd, bwd)
+    return ffn
+
+
+@jax.custom_vjp
+def gather_sum(z: jax.Array, sorted_tok: jax.Array,
+               pos: jax.Array) -> jax.Array:
+    """out[t] = Σ_slot z[pos[slot,t]] — the UNWEIGHTED combine gather for
+    the scaled FFN (combine weights applied in-kernel; pos [k, S]).
+    Residual-free: the VJP is the opposite gather, so nothing of the FFN
+    output has to survive to (or be rebuilt for) the backward pass."""
+    out = z[pos[0]]
+    for slot in range(1, pos.shape[0]):
+        out = out + z[pos[slot]]
+    return out
+
+
+def _gather_sum_fwd(z, sorted_tok, pos):
+    return gather_sum(z, sorted_tok, pos), (sorted_tok, pos.shape)
+
+
+def _gather_sum_bwd(res, dout):
+    sorted_tok, pos_shape = res
+    # sentinel rows (padding / dead tail) index the appended zero row
+    dout1 = jnp.concatenate(
+        [dout, jnp.zeros((1, dout.shape[-1]), dout.dtype)])
+    return (dout1[sorted_tok], np.zeros(sorted_tok.shape,
+                                        jax.dtypes.float0),
+            np.zeros(pos_shape, jax.dtypes.float0))
+
+
+gather_sum.defvjp(_gather_sum_fwd, _gather_sum_bwd)
+
+
 def grouped_glu_ffn(xs: jax.Array, wg: jax.Array, wi: jax.Array,
                     wo: jax.Array, group_of_tile: jax.Array,
                     sizes_padded: jax.Array, live_tiles: jax.Array, *,
                     bm: int, bnf: int, bnd: int,
+                    w: Optional[jax.Array] = None,
                     interpret: bool = False) -> jax.Array:
     """Grouped SwiGLU FFN over a block-aligned sorted row layout.
 
     xs [R_pad, d] (rows sorted by expert, padding rows zero), wg/wi
-    [E, d, f], wo [E, f, d] → Y [R_pad, d] (unscaled; the caller applies
-    combine weights so the gate-weight gradient stays in autodiff-land).
+    [E, d, f], wo [E, f, d] → Y [R_pad, d].
+
+    ``w=None``: unscaled output; the caller applies combine weights
+    (gate-weight gradient stays in autodiff-land via
+    :func:`gather_combine`). ``w`` [R_pad] (``sorted_w`` from
+    :func:`aligned_dispatch`): the weights are fused into the down
+    kernel, their gradient into the dgdu kernel, and the output is
+    combined with the residual-free :func:`gather_sum` — the fast
+    training path.
     """
-    return _build_ffn(bm, bnf, bnd, interpret)(
-        xs, wg, wi, wo, group_of_tile, sizes_padded, live_tiles)
+    if w is None:
+        return _build_ffn(bm, bnf, bnd, interpret)(
+            xs, wg, wi, wo, group_of_tile, sizes_padded, live_tiles)
+    if bm % _LANE:
+        raise ValueError(
+            f"grouped_glu_ffn(w=...): the fused-combine path's "
+            f"lanes-major w tiles require bm % {_LANE} == 0, got bm={bm}"
+            f"; pass w=None and apply combine weights via gather_combine")
+    w2 = w.reshape(xs.shape[0] // bm, 1, bm)
+    return _build_ffn_w(bm, bnf, bnd, interpret)(
+        xs, w2, wg, wi, wo, group_of_tile, sizes_padded, live_tiles)

@@ -32,6 +32,30 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.comm.comms_logger import comms_logger
 
 
+def topk_gates_t(gates_t: jax.Array, k: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Transposed top-k: ``gates_t`` [E, S] → (topv_t, topi_t) [k, S].
+
+    The whole dropless routing chain runs in this [E, S] orientation —
+    E on SUBLANES, tokens on lanes — so softmax/max/argmax reduce over
+    8 sublanes with all 128 lanes busy. The [S, E] orientation puts E
+    on lanes (8 of 128 used) and measured ~2 ms/layer of pure layout
+    waste at [16K, 8] fwd+bwd on v5e (the same finding that shaped
+    ``aligned_dispatch``'s [E, R0] histogram).
+    """
+    e = gates_t.shape[0]
+    rows = jnp.arange(e, dtype=jnp.int32)
+    g = gates_t
+    vals, idxs = [], []
+    for _ in range(k):
+        v = jnp.max(g, axis=0)
+        i = jnp.argmax(g, axis=0).astype(jnp.int32)
+        vals.append(v)
+        idxs.append(i)
+        g = jnp.where(rows[:, None] == i[None, :], -jnp.inf, g)
+    return jnp.stack(vals, 0), jnp.stack(idxs, 0)
+
+
 def _capacity(num_tokens: int, num_experts: int, k: int,
               capacity_factor: float, min_capacity: int) -> int:
     """Reference sharded_moe.py:_capacity — static on TPU (shapes fixed
@@ -149,7 +173,8 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
                   top_k: int) -> jax.Array:
     """Token-local dropless dispatch: sort + grouped matmul + combine.
 
-    xf [S,d], topv/topi [S,k] → out [S,d]. Every op is per-token local
+    xf [S,d], topv/topi [k,S] SLOT-MAJOR (``topk_gates_t``'s layout —
+    tokens on lanes) → out [S,d]. Every op is per-token local
     (no collectives), so this body runs unchanged either globally or as
     the per-shard body of a shard_map over the batch axes.
 
@@ -180,19 +205,36 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
         # (bf16 [R_pad, d], ~74MB/layer at the 16K-token bench) so the
         # remat backward does not re-run it
         xs = checkpoint_name(gmm.gather_rows(xf1, tok, pos), "moe_xs")
-        y = gmm.grouped_glu_ffn(
-            xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
-            p["wo"].astype(xs.dtype), g_of_tile, sizes, live,
-            bm=bm, bnf=bnf, bnd=bnd,
-            interpret=jax.default_backend() != "tpu")
-        # combine = gather over the inverse map (no token scatter-add)
-        out = gmm.gather_combine(y, w.astype(y.dtype), tok, pos)
+        if bm % 128 == 0:
+            # combine weights fused into the kernels (w applied in the
+            # down kernel, dw computed in the dgdu kernel), so the
+            # combine below is a residual-free gather-sum: no [R,d]
+            # scale sweep fwd/bwd, no separate dw row-dot, and the FFN
+            # output is nobody's VJP residual — with "moe_glu" saved the
+            # layer backward re-runs nothing (ops/grouped_matmul.py
+            # module docstring)
+            z = gmm.grouped_glu_ffn(
+                xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
+                p["wo"].astype(xs.dtype), g_of_tile, sizes, live,
+                bm=bm, bnf=bnf, bnd=bnd, w=w,
+                interpret=jax.default_backend() != "tpu")
+            out = gmm.gather_sum(z, tok, pos)
+        else:
+            # the fused path's lanes-major w tiles need bm % 128 == 0
+            # (TPU block rule); tiny-bm geometries (VMEM-shrunk or
+            # DSTPU_GMM_BM override) keep the unfused combine
+            y = gmm.grouped_glu_ffn(
+                xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
+                p["wo"].astype(xs.dtype), g_of_tile, sizes, live,
+                bm=bm, bnf=bnf, bnd=bnd,
+                interpret=jax.default_backend() != "tpu")
+            out = gmm.gather_combine(y, w.astype(y.dtype), tok, pos)
     else:
-        # stable sort of the S*k (token, slot) assignments by expert id
-        flat_e = topi.reshape(-1)                             # [S*k]
-        order = jnp.argsort(flat_e, stable=True)              # [S*k]
-        tok = order // top_k                                  # source token
-        xs = xf[tok]                                          # [S*k, d]
+        # stable sort of the S*k (slot, token) assignments by expert id
+        flat_e = topi.reshape(-1)                             # [k*S]
+        order = jnp.argsort(flat_e, stable=True)              # [k*S]
+        tok = order % s                                       # source token
+        xs = xf[tok]                                          # [k*S, d]
         group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
 
         gate_b = lax.ragged_dot(xs, p["wg"].astype(xs.dtype), group_sizes)
@@ -201,7 +243,7 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
         out_s = lax.ragged_dot(hidden, p["wo"].astype(xs.dtype),
                                group_sizes)
 
-        w = topv.reshape(-1)[order].astype(xf.dtype)          # [S*k]
+        w = topv.reshape(-1)[order].astype(xf.dtype)          # [k*S]
         out = jnp.zeros((s, d), xf.dtype).at[tok].add(out_s * w[:, None])
 
     if "shared" in p:   # dense shared expert, same as the capacity path
@@ -243,17 +285,23 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
     e = p["router"].shape[-1]
     s = b * t
     xf = x.reshape(s, d)
-    logits = jnp.einsum("sd,de->se", xf.astype(jnp.float32),
-                        p["router"].astype(jnp.float32))
-    gates = jax.nn.softmax(logits, axis=-1)                   # [S,E]
-    topv, topi = lax.top_k(gates, top_k)                      # [S,k]
+    # the ENTIRE routing chain runs transposed — [E, S] / [k, S],
+    # tokens on lanes. The [S, E] orientation puts E (8ish) on lanes
+    # and measured ~2 ms/layer of layout waste at the 16K-token bench
+    # (topk_gates_t docstring); the thin matmul below has M=E on
+    # sublanes instead of lanes, which XLA tiles fine.
+    logits_t = jnp.einsum("de,sd->es", p["router"].astype(jnp.float32),
+                          xf.astype(jnp.float32))             # [E,S]
+    gates_t = jax.nn.softmax(logits_t, axis=0)                # [E,S]
+    topv, topi = topk_gates_t(gates_t, top_k)                 # [k,S]
     if norm_topk:
-        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+        topv = topv / jnp.maximum(topv.sum(0, keepdims=True), 1e-9)
 
     # aux loss — identical formulation to the capacity path (global
     # means over all tokens, GSPMD-reduced)
-    mask1 = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
-    aux = jnp.sum(gates.mean(axis=0) * mask1.mean(axis=0)) * e
+    mask1_t = (jnp.arange(e, dtype=jnp.int32)[:, None]
+               == topi[0][None, :]).astype(jnp.float32)       # [E,S]
+    aux = jnp.sum(gates_t.mean(axis=1) * mask1_t.mean(axis=1)) * e
 
     batch_axes: Tuple[str, ...] = ()
     from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
@@ -269,11 +317,12 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
             batch_axes = ()
 
     if batch_axes:
-        spec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0],
-                 None)
+        ax = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+        spec = P(ax, None)
+        spec_t = P(None, ax)    # [k, S] — tokens are the SECOND axis
         fn = jax.shard_map(
             partial(_dropless_ffn, top_k=top_k),
-            mesh=mesh, in_specs=(P(), spec, spec, spec),
+            mesh=mesh, in_specs=(P(), spec, spec_t, spec_t),
             out_specs=spec, axis_names=set(batch_axes), check_vma=False)
         out = fn(p, xf, topv, topi)
     else:

@@ -10,7 +10,8 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.ops.grouped_matmul import (
-    aligned_dispatch, grouped_glu_ffn, pick_blocks, supported)
+    aligned_dispatch, gather_rows, gather_sum, grouped_glu_ffn,
+    pick_blocks, supported)
 
 
 def _ref_ffn(xs, wg, wi, wo, sizes_padded):
@@ -45,7 +46,7 @@ def _mk(seed, s, k, e, d, f, dtype=jnp.float32):
 def test_aligned_dispatch_layout():
     s, k, e, bm = 37, 2, 4, 8
     topi, topv, *_ = _mk(0, s, k, e, 16, 32)
-    tok, w, got, sizes, pos, live = aligned_dispatch(topi, topv, e, bm)
+    tok, w, got, sizes, pos, live = aligned_dispatch(topi.T, topv.T, e, bm)
     r_pad = tok.shape[0]
     assert r_pad % bm == 0
     assert int(sizes.sum()) == r_pad
@@ -91,7 +92,7 @@ def test_aligned_dispatch_layout():
 def test_forward_parity(s, k, e, d, f):
     topi, topv, xf, wg, wi, wo = _mk(1, s, k, e, d, f)
     bm, bnf, bnd = pick_blocks(d, f)
-    tok, w, got, sizes, pos, live = aligned_dispatch(topi, topv, e, bm)
+    tok, w, got, sizes, pos, live = aligned_dispatch(topi.T, topv.T, e, bm)
     xf1 = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
     xs = xf1[tok]
     y = grouped_glu_ffn(xs, wg, wi, wo, got, sizes, live,
@@ -114,7 +115,7 @@ def test_empty_and_skewed_experts():
     wi = jnp.asarray(rng.randn(e, d, f) * 0.05, jnp.float32)
     wo = jnp.asarray(rng.randn(e, f, d) * 0.05, jnp.float32)
     bm, bnf, bnd = pick_blocks(d, f)
-    tok, w, got, sizes, pos, live = aligned_dispatch(topi, topv, e, bm)
+    tok, w, got, sizes, pos, live = aligned_dispatch(topi.T, topv.T, e, bm)
     xs = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])[tok]
     y = grouped_glu_ffn(xs, wg, wi, wo, got, sizes, live,
                         bm=bm, bnf=bnf, bnd=bnd, interpret=True)
@@ -135,7 +136,7 @@ def test_grad_parity(dw_mode, monkeypatch):
     s, k, e, d, f = 32, 2, 4, 128, 128
     topi, topv, xf, wg, wi, wo = _mk(5, s, k, e, d, f)
     bm, bnf, bnd = pick_blocks(d, f)
-    tok, w, got, sizes, pos, live = aligned_dispatch(topi, topv, e, bm)
+    tok, w, got, sizes, pos, live = aligned_dispatch(topi.T, topv.T, e, bm)
     xf1 = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
     xs = xf1[tok]
 
@@ -169,6 +170,55 @@ def test_grad_parity(dw_mode, monkeypatch):
             a, b = a[:end], b[:end]
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3,
                                    err_msg=name)
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("dw_mode", ["pallas", "ragged"])
+def test_scaled_ffn_and_gather_sum_parity(dw_mode, monkeypatch):
+    """The fused-combine path (w applied in the down kernel, dw computed
+    in the dgdu kernel, gather_sum combine) against plain autodiff of
+    the unfused formulation — full layer: out[t] = Σ_slot w·FFN(x)[pos].
+    Covers dxs, all three weight grads, AND dtopv (the router signal
+    that the in-kernel rowsum produces), with f chosen so bnf ∤ f
+    exercises the masked partial-tile reduce."""
+    monkeypatch.setenv("DSTPU_GMM_DW", dw_mode)
+    s, k, e, d, f = 48, 2, 4, 128, 384
+    topi, topv, xf, wg, wi, wo = _mk(7, s, k, e, d, f)
+    bm, bnf, bnd = pick_blocks(d, f)
+    bnf = 256   # force a partial last f tile (384 = 256 + 128)
+    cos = jnp.cos(jnp.arange(d))
+
+    def loss_fused(xf, topv, wg, wi, wo):
+        tok, w, got, sizes, pos, live = aligned_dispatch(topi.T, topv.T,
+                                                         e, bm)
+        xf1 = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
+        xs = gather_rows(xf1, tok, pos)
+        z = grouped_glu_ffn(xs, wg, wi, wo, got, sizes, live,
+                            bm=bm, bnf=bnf, bnd=bnd, w=w,
+                            interpret=True)
+        out = gather_sum(z, tok, pos)
+        return jnp.sum(out * cos)
+
+    def loss_ref(xf, topv, wg, wi, wo):
+        gate = jnp.einsum("sd,edf->esf", xf, wg)
+        up = jnp.einsum("sd,edf->esf", xf, wi)
+        y = jnp.einsum("esf,efd->esd", jax.nn.silu(gate) * up, wo)
+        out = jnp.zeros_like(xf)
+        for slot in range(k):
+            y_sel = y[topi[:, slot], jnp.arange(s)]           # [S, d]
+            out = out + topv[:, slot][:, None] * y_sel
+        return jnp.sum(out * cos)
+
+    gp = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(
+        xf, topv, wg, wi, wo)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(
+        xf, topv, wg, wi, wo)
+    np.testing.assert_allclose(float(loss_fused(xf, topv, wg, wi, wo)),
+                               float(loss_ref(xf, topv, wg, wi, wo)),
+                               rtol=2e-4)
+    for a, b, name in zip(gp, gr, ("dxf", "dtopv", "dwg", "dwi", "dwo")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-3, err_msg=name)
 
 
 def test_supported_gate():
