@@ -136,11 +136,10 @@ def moe_main(args) -> None:
         "bf16": {"enabled": bool(on_tpu)},
         "gradient_clipping": 1.0,
         "moe": {"impl": os.environ.get("DSTPU_BENCH_MOE_IMPL", "dropless")},
-        # save_attn_kernel_moe_glu (backward re-runs ZERO MoE kernels —
-        # verified 6→5 pallas calls in the compiled HLO) measured ~1pt
-        # SLOWER than letting the gate_up kernel re-run: the 4.7GB of
-        # stacked [L,R,f] GLU residuals cost more in scan traffic than
-        # the 2 recomputed matmul units. Re-measure per geometry.
+        # the fused MoE backward recomputes gate/up in-kernel, so no
+        # policy choice affects the FFN re-run; save_attn_kernel keeps
+        # the flash residuals (saving moe_glu residual stacks measured
+        # ~1pt SLOWER than recompute at this geometry)
         "activation_checkpointing": {
             "policy": os.environ.get(
                 "DSTPU_BENCH_MOE_POLICY",

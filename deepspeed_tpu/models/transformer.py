@@ -446,9 +446,11 @@ def resolve_remat_policy(name: Optional[str]):
             jax.checkpoint_policies.save_only_these_names(
                 "attn_kernel_out", "attn_lse", "moe_dispatch",
                 "moe_xs"),
-        # + the MoE GLU pre-activations: backward skips the gate/up/down
-        # kernel re-run at ~2x[R, ffn] bf16 per layer of extra HBM —
-        # measure before enabling at long sequence
+        # + the MoE GLU pre-activations (~2x[R, ffn] bf16 per layer of
+        # extra HBM). Only affects the UNSCALED grouped-matmul path —
+        # the default fused-combine path recomputes gate/up in-kernel
+        # and has no moe_glu residuals (measured FASTER than stacking
+        # them across the layer scan; ops/grouped_matmul.py docstring)
         "save_attn_kernel_moe_glu":
             jax.checkpoint_policies.save_only_these_names(
                 "attn_kernel_out", "attn_lse", "moe_dispatch",

@@ -50,15 +50,21 @@ their gradient (``dw[r] = dZ[r]·y[r]``, the router's training signal)
 inside the dgdu kernel as a per-f-tile ``rowsum(dh·h)`` — both already
 have the operands streaming through VMEM. The combine then collapses to
 the residual-free :func:`gather_sum`: no ``[R,d]`` elementwise scale in
-fwd or bwd, no separate ``[R,d]`` row-dot for ``dw``, and — because the
-FFN output is no longer anyone's VJP residual — remat policies that save
-``moe_glu`` re-run NOTHING of the FFN in backward (12 → 9 executed
-matmul units per layer under ``save_attn_kernel_moe_glu``).
+fwd or bwd, no separate ``[R,d]`` row-dot for ``dw``.
+
+**Residual-free backward (r5).** The scaled path's dgdu kernel
+(:func:`_dgdu_rc_kernel`) recomputes the GLU pre-activations in-kernel
+from ``xs``, so the VJP residuals carry NO ``[R, f]`` tensors at all:
+under any remat policy the layer backward re-runs zero kernels, and
+gate/up never round-trip HBM in the backward (the old path either
+re-ran the gate_up kernel — writing 2×[R,f] that dgdu then re-read —
+or stacked 4.7 GB of ``moe_glu`` residuals across the layer scan,
+which measured SLOWER than the re-run).
 
 Parity is asserted against a per-expert einsum reference in
 tests/test_grouped_matmul.py; integration (full dropless layer fwd+bwd vs
 the ragged_dot path, including router gradients) in tests/test_moe.py.
-Measured on the r5 1B/8e bench: 26.3% → 33.4% active-param MFU.
+Measured on the r5 1B/8e bench: 26.3% → 35.9% active-param MFU.
 """
 
 import functools
@@ -275,9 +281,10 @@ def pick_blocks(d: int, f: int, itemsize: int = 2
                 ) -> Tuple[int, int, int]:
     """(bm, bnf, bnd) for the kernel suite, shrunk to the VMEM budget.
 
-    Env overrides: DSTPU_GMM_BM / DSTPU_GMM_BNF / DSTPU_GMM_BND. The
-    dxs kernel derives its own narrower n-block (two full-K weight
-    blocks in flight) — see :func:`_dxs`.
+    Env overrides: DSTPU_GMM_BM / DSTPU_GMM_BNF / DSTPU_GMM_BND govern
+    the forward kernels; the backward kernels size their own tiles
+    (DSTPU_GMM_BNF_BWD in :func:`_dgdu_rc`, DSTPU_GMM_BND_BWD in
+    :func:`_dxs`).
     """
     # defaults from the r5 on-chip sweep (1B/8e bench geometry, v5e):
     # bnf 256 < 512 < 1024 < 1408 (13.5/13.9/15.5/17.6 ms per layer
@@ -390,19 +397,28 @@ def _dgdu_kernel(g_ref, lt_ref, dy_ref, wo_ref, gate_ref, up_ref,
             dwo_ref[0] = acc_o[...]
 
 
-def _dgdu_w_kernel(g_ref, lt_ref, dz_ref, w_ref, wo_ref, gate_ref,
-                   up_ref, dg_ref, du_ref, dwo_ref, dwp_ref, acc_o, *,
-                   f_total, bnf):
-    """The scaled-FFN backward tile: upstream dZ arrives UNSCALED by the
-    combine weights (the combine is a plain gather-sum), so this kernel
-    additionally produces the combine-weight gradient
+def _dgdu_rc_kernel(g_ref, lt_ref, dz_ref, w_ref, xs_ref, wg_ref, wi_ref,
+                    wo_ref, dg_ref, du_ref, dwo_ref, dwp_ref, acc_o, *,
+                    f_total, bnf):
+    """The scaled-FFN backward tile with the GLU pre-activations
+    RECOMPUTED in-kernel from ``xs`` instead of read from HBM.
 
-        dw[r] = dZ[r]·y[r] = Σ_f (dZ·wo[g]^T)[r,f] · h[r,f]
+    Upstream dZ arrives UNSCALED by the combine weights (the combine is
+    a plain gather-sum), so this kernel additionally produces the
+    combine-weight gradient ``dw[r] = dZ[r]·y[r] = Σ_f dh[r,f]·h[r,f]``
+    as per-f-tile partials (``dwp_ref``; summed over f-tiles by the
+    caller), and dgate/dup/dwo pick up the per-row w factor
+    (``d(h·wo) = w ⊙ dZ``).
 
-    as per-f-tile partials (``dwp_ref`` [1,1,bm]; summed over f-tiles by
-    the caller) — dh and h are already live in VMEM, so the row-dot that
-    used to re-sweep [R,d] from HBM costs one masked VPU reduce here.
-    dgate/dup/dwo pick up the per-row w factor (d(h@wo) = w ⊙ dZ)."""
+    This removes the remat re-run of the gate_up kernel from the layer
+    backward entirely: the scaled FFN's VJP residuals are just
+    (xs, w, weights, dispatch metadata) — xs is already kept by the
+    ``moe_xs`` save — so under ANY remat policy the backward re-runs
+    nothing and gate/up never round-trip HBM in the backward (the
+    re-run wrote 2×[R,f] and this kernel re-read them; both gone for
+    the cost of streaming xs once per f-tile). Grid (n_f, n_m), m
+    innermost; wg/wi blocks ride the existing expert-monotone index
+    maps so they refetch only on transitions."""
     i = pl.program_id(1)
     nm = pl.num_programs(1)
     j = pl.program_id(0)
@@ -419,17 +435,20 @@ def _dgdu_w_kernel(g_ref, lt_ref, dz_ref, w_ref, wo_ref, gate_ref,
 
         dz = dz_ref[...]
         w32 = w_ref[0, 0].astype(jnp.float32)                # [bm] lanes
+        xs = xs_ref[...]
+        # recompute this f-tile's gate/up (bitwise the forward kernel's
+        # math: bf16 operands, f32 MXU accumulation, cast back)
+        g32 = jnp.dot(xs, wg_ref[0],
+                      preferred_element_type=jnp.float32)
+        u32 = jnp.dot(xs, wi_ref[0],
+                      preferred_element_type=jnp.float32)
+        g32 = g32.astype(dz.dtype).astype(jnp.float32)
+        u32 = u32.astype(dz.dtype).astype(jnp.float32)
         dh = lax.dot_general(dz, wo_ref[0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        g32 = gate_ref[...].astype(jnp.float32)
-        u32 = up_ref[...].astype(jnp.float32)
         sg = jax.nn.sigmoid(g32)
         silu_g = g32 * sg
         h32 = silu_g * u32
-        # the last f tile is partial when bnf ∤ f — its out-of-range
-        # lanes hold unspecified loads. Harmless for dg/du/dwo (their
-        # writes are masked the same way) but the dw reduce SUMS over
-        # lanes, so mask before reducing.
         if f_total % bnf:
             col = lax.broadcasted_iota(jnp.int32, h32.shape, 1)
             valid = (col + j * bnf) < f_total
@@ -591,22 +610,29 @@ def _down_w(gate, up, w2, wo, g_of_tile, live_tiles, bm, bnd, interpret):
                       interpret, g_of_tile, live_tiles, gate, up, w2, wo)
 
 
-def _dgdu_w(dz, w2, wo, gate, up, g_of_tile, live_tiles, num_experts,
-            bm, bnf, interpret):
-    """→ (dg, du [R_pad, f], dwo [E, f, d] f32, dwp [n_f, nm, bm] f32).
-    The caller sums dwp over its leading axis for dw."""
+def _dgdu_rc(dz, w2, xs, wg, wi, wo, g_of_tile, live_tiles, num_experts,
+             bm, interpret):
+    """→ (dg, du [R_pad, f], dwo [E, f, d] f32, dwp [n_f, nm, 1, bm]).
+    f-tile size: DSTPU_GMM_BNF_BWD (default 256 — dz AND xs re-stream
+    once per f-tile here, so bigger tiles cut the dominant HBM term;
+    512 is the VMEM ceiling with the dwo accumulator resident)."""
     r_pad, d = dz.shape
-    f = gate.shape[-1]
-    bnf = min(bnf, 512)
+    f = wg.shape[-1]
+    # clamp at 512 regardless of the env: wg+wi+wo blocks plus the
+    # (bnf, d) f32 dwo accumulator exceed scoped VMEM past it
+    # (measured: 16.98M vs the 16M limit at bnf=512 on the 1B/8e bench)
+    bnf = min(_block(f, int(os.environ.get("DSTPU_GMM_BNF_BWD", 256))),
+              512)
     nf = pl.cdiv(f, bnf)
     nm = r_pad // bm
     grid = (nf, nm)
     specs = [
         pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
         pl.BlockSpec((1, 1, bm), lambda j, i, g, lt: (i, 0, 0)),
+        pl.BlockSpec((bm, d), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((1, d, bnf), lambda j, i, g, lt: (g[i], 0, j)),
+        pl.BlockSpec((1, d, bnf), lambda j, i, g, lt: (g[i], 0, j)),
         pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
-        pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
     ]
     out_specs = [
         pl.BlockSpec((bm, bnf), lambda j, i, g, lt: (i, j)),
@@ -614,15 +640,15 @@ def _dgdu_w(dz, w2, wo, gate, up, g_of_tile, live_tiles, num_experts,
         pl.BlockSpec((1, bnf, d), lambda j, i, g, lt: (g[i], j, 0)),
         pl.BlockSpec((1, 1, 1, bm), lambda j, i, g, lt: (j, i, 0, 0)),
     ]
-    shape = [jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
-             jax.ShapeDtypeStruct((r_pad, f), gate.dtype),
+    shape = [jax.ShapeDtypeStruct((r_pad, f), dz.dtype),
+             jax.ShapeDtypeStruct((r_pad, f), dz.dtype),
              jax.ShapeDtypeStruct((num_experts, f, d), jnp.float32),
              jax.ShapeDtypeStruct((nf, nm, 1, bm), jnp.float32)]
     scratch = [pltpu.VMEM((bnf, d), jnp.float32)]
-    kernel = functools.partial(_dgdu_w_kernel, f_total=f, bnf=bnf)
+    kernel = functools.partial(_dgdu_rc_kernel, f_total=f, bnf=bnf)
     return _grid_call(kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, live_tiles, dz, w2, wo, gate,
-                      up, scratch=scratch)
+                      interpret, g_of_tile, live_tiles, dz, w2, xs, wg,
+                      wi, wo, scratch=scratch)
 
 
 def _dgdu(dy, wo, gate, up, g_of_tile, live_tiles, num_experts, bm,
@@ -657,23 +683,41 @@ def _dgdu(dy, wo, gate, up, g_of_tile, live_tiles, num_experts, bm,
 
 def _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd, interpret):
     """dxs = dg·wg^T + du·wi^T with the weights in their native [E, d, f]
-    layout (d-slice blocks, contraction on f)."""
+    layout (d-slice blocks, contraction on f).
+
+    dg/du stream ONCE PER d-TILE here — the kernel's dominant HBM term
+    (full-f rows: n_d × 2×[R,f]). So instead of halving the d-tile to
+    fit the two full-K weight blocks in VMEM (4 d-tiles → 1.57 GB of
+    dg/du traffic at the 16K-token bench), SUBDIVIDE the m-tiles to
+    bm_x = 128: the aligned layout's tile boundaries are multiples of
+    bm, so every 128-sub-tile still has one owning expert
+    (``repeat(group_of_tile, bm/128)``) and d-tiles stay big.
+    DSTPU_GMM_BND_BWD overrides the d-tile (default 512 → 2 sweeps)."""
     r_pad, f = dg.shape
     d = wg.shape[1]
-    # two full-K weight blocks are in flight here (vs one in _down) —
-    # halve the n block to stay inside VMEM
-    bnd = max(_LANE, bnd // 2)
-    grid = (pl.cdiv(d, bnd), r_pad // bm)
+    if bm > 128 and bm % 128 == 0:
+        bm_x = 128
+        sub = bm // bm_x
+        g_x = jnp.repeat(g_of_tile, sub)
+        lt_x = live_tiles * sub
+        bnd = _block(d, int(os.environ.get("DSTPU_GMM_BND_BWD", 512)))
+    else:
+        # bm not 128-divisible: sub-tiles would straddle expert
+        # boundaries — keep whole m-tiles and halve the d-tile for VMEM
+        # (the pre-subdivision behavior)
+        bm_x, g_x, lt_x = bm, g_of_tile, live_tiles
+        bnd = max(_LANE, bnd // 2)
+    grid = (pl.cdiv(d, bnd), r_pad // bm_x)
     specs = [
-        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
-        pl.BlockSpec((bm, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm_x, f), lambda j, i, g, lt: (i, 0)),
+        pl.BlockSpec((bm_x, f), lambda j, i, g, lt: (i, 0)),
         pl.BlockSpec((1, bnd, f), lambda j, i, g, lt: (g[i], j, 0)),
         pl.BlockSpec((1, bnd, f), lambda j, i, g, lt: (g[i], j, 0)),
     ]
-    out_specs = pl.BlockSpec((bm, bnd), lambda j, i, g, lt: (i, j))
+    out_specs = pl.BlockSpec((bm_x, bnd), lambda j, i, g, lt: (i, j))
     shape = jax.ShapeDtypeStruct((r_pad, d), dg.dtype)
     return _grid_call(_dxs_kernel, grid, specs, out_specs, shape,
-                      interpret, g_of_tile, live_tiles, dg, du, wg, wi)
+                      interpret, g_x, lt_x, dg, du, wg, wi)
 
 
 # ---------------------------------------------------------------------------
@@ -773,11 +817,11 @@ def _build_ffn(bm: int, bnf: int, bnd: int, interpret: bool):
 def _build_ffn_w(bm: int, bnf: int, bnd: int, interpret: bool):
     """Scaled variant: (xs, w2, wg, wi, wo, meta…) -> Z with the per-row
     combine weights applied in the down kernel and their gradient
-    computed in the dgdu kernel (see :func:`_dgdu_w_kernel`). Z is NOT a
-    VJP residual of anything downstream — the combine is the
-    residual-free :func:`gather_sum` — so saving ``moe_glu`` (+ the
-    dispatch metadata and ``moe_xs``) makes the layer backward re-run
-    zero kernels under remat."""
+    computed in the dgdu kernel (see :func:`_dgdu_rc_kernel`). The VJP
+    residuals are just (xs, w2, weights, dispatch metadata) — no [R,f]
+    tensors: the backward recomputes gate/up in-kernel, so under ANY
+    remat policy the layer backward re-runs zero kernels (no ``moe_glu``
+    save needed; that name only matters for the unscaled path)."""
 
     @jax.custom_vjp
     def ffn(xs, w2, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
@@ -787,22 +831,23 @@ def _build_ffn_w(bm: int, bnf: int, bnd: int, interpret: bool):
                        interpret)
 
     def fwd(xs, w2, wg, wi, wo, g_of_tile, sizes_padded, live_tiles):
-        from jax.ad_checkpoint import checkpoint_name
         gate, up = _gate_up(xs, wg, wi, g_of_tile, live_tiles, bm, bnf,
                             interpret)
-        gate = checkpoint_name(gate, "moe_glu")
-        up = checkpoint_name(up, "moe_glu")
         z = _down_w(gate, up, w2, wo, g_of_tile, live_tiles, bm, bnd,
                     interpret)
-        return z, (xs, w2, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+        # residuals carry NO [R, f] tensors: the backward recomputes
+        # gate/up in-kernel from xs (_dgdu_rc_kernel), so under any
+        # remat policy the layer backward re-runs nothing and the GLU
+        # pre-activations never round-trip HBM in the backward
+        return z, (xs, w2, wg, wi, wo, g_of_tile, sizes_padded,
                    live_tiles)
 
     def bwd(res, dz):
-        (xs, w2, gate, up, wg, wi, wo, g_of_tile, sizes_padded,
+        (xs, w2, wg, wi, wo, g_of_tile, sizes_padded,
          live_tiles) = res
         e = wg.shape[0]
-        dg, du, dwo32, dwp = _dgdu_w(dz, w2, wo, gate, up, g_of_tile,
-                                     live_tiles, e, bm, bnf, interpret)
+        dg, du, dwo32, dwp = _dgdu_rc(dz, w2, xs, wg, wi, wo, g_of_tile,
+                                      live_tiles, e, bm, interpret)
         if os.environ.get("DSTPU_GMM_DCOMBINE") == "zero":
             # BENCH-ONLY diagnostic: drop the router's training signal
             # to expose the combine-weight-grad cost
@@ -825,10 +870,14 @@ def _build_ffn_w(bm: int, bnf: int, bnd: int, interpret: bool):
             du_z = jnp.where(alive, du, 0)
             dwg = _dw_ragged(xs, dg_z, sizes_padded, e)
             dwi = _dw_ragged(xs, du_z, sizes_padded, e)
+            # gate/up are no longer residuals — rebuild hidden over the
+            # aligned layout (exact: padding rows are zero in xs)
+            gate_r = lax.ragged_dot(xs, wg, sizes_padded)
+            up_r = lax.ragged_dot(xs, wi, sizes_padded)
             hidden = jnp.where(
                 alive,
-                (jax.nn.silu(gate.astype(jnp.float32))
-                 * up.astype(jnp.float32)).astype(gate.dtype), 0)
+                (jax.nn.silu(gate_r.astype(jnp.float32))
+                 * up_r.astype(jnp.float32)).astype(gate_r.dtype), 0)
             # d(h·wo) = w ⊙ dZ under the fused scaling
             dzw = jnp.where(
                 alive,

@@ -207,12 +207,11 @@ def _dropless_ffn(p, xf: jax.Array, topv: jax.Array, topi: jax.Array,
         xs = checkpoint_name(gmm.gather_rows(xf1, tok, pos), "moe_xs")
         if bm % 128 == 0:
             # combine weights fused into the kernels (w applied in the
-            # down kernel, dw computed in the dgdu kernel), so the
-            # combine below is a residual-free gather-sum: no [R,d]
-            # scale sweep fwd/bwd, no separate dw row-dot, and the FFN
-            # output is nobody's VJP residual — with "moe_glu" saved the
-            # layer backward re-runs nothing (ops/grouped_matmul.py
-            # module docstring)
+            # down kernel, dw computed in the dgdu kernel), the combine
+            # below is a residual-free gather-sum, and the backward
+            # recomputes gate/up in-kernel from xs — so the layer
+            # backward re-runs nothing under any remat policy
+            # (ops/grouped_matmul.py module docstring)
             z = gmm.grouped_glu_ffn(
                 xs, p["wg"].astype(xs.dtype), p["wi"].astype(xs.dtype),
                 p["wo"].astype(xs.dtype), g_of_tile, sizes, live,
