@@ -119,9 +119,16 @@ def moe_main(args) -> None:
     warmup = 3 if on_tpu else 1
     ds.build_mesh(data=n_dev)
     if on_tpu:
+        # head_dim 128 (8 heads), the TPU-native choice every production
+        # family here uses (llama3/qwen2/mixtral all ship Dh=128): at
+        # the old 16x64 config the flash kernels are VPU-bound (QK^T
+        # contracts over 64 = half the MXU depth; traced at ~6.5
+        # ms/layer vs ~3.5 at Dh=128) — same params, same active FLOPs,
+        # same GQA ratio. Measured 36.4% -> 41.8% MFU on this bench
+        # (the r5 kernel work lifted 26.3% -> 36.4% before this).
         model = mixtral_config(
-            "tiny", hidden_size=1024, num_layers=12, num_heads=16,
-            num_kv_heads=8, intermediate_size=2816, num_experts=8,
+            "tiny", hidden_size=1024, num_layers=12, num_heads=8,
+            num_kv_heads=4, intermediate_size=2816, num_experts=8,
             num_experts_per_tok=2, vocab_size=32000, max_seq_len=seq,
             tie_embeddings=True)
     else:
@@ -137,15 +144,21 @@ def moe_main(args) -> None:
         "gradient_clipping": 1.0,
         "moe": {"impl": os.environ.get("DSTPU_BENCH_MOE_IMPL", "dropless")},
         # the fused MoE backward recomputes gate/up in-kernel, so no
-        # policy choice affects the FFN re-run; save_attn_kernel keeps
-        # the flash residuals (saving moe_glu residual stacks measured
-        # ~1pt SLOWER than recompute at this geometry)
+        # policy choice affects the FFN re-run. save_attn_kernel_qkv
+        # additionally keeps post-rope q/k/v: measured +0.4pt over
+        # save_attn_kernel at THIS geometry (32-step pairs, r5) — the
+        # 20pt qkv-residency loss documented for the 1.27B dense bench
+        # does not reproduce at this smaller model's memory point.
+        # (Saving moe_glu residual stacks instead measured ~1pt slower
+        # than the in-kernel recompute.)
         "activation_checkpointing": {
             "policy": os.environ.get(
                 "DSTPU_BENCH_MOE_POLICY",
-                "save_attn_kernel") if on_tpu else "none"},
+                "save_attn_kernel_qkv") if on_tpu else "none"},
         "ce_logits_dtype": "bf16" if on_tpu else None,
-        "chunked_ce_budget_mb": 256 if on_tpu else None,
+        # DSTPU_BENCH_CE_MB=0 -> None (unchunked CE)
+        "chunked_ce_budget_mb": (int(os.environ.get(
+            "DSTPU_BENCH_CE_MB", 256)) or None) if on_tpu else None,
         "steps_per_print": 1000,
     }
     engine, *_ = ds.initialize(model=model, config=config,

@@ -160,6 +160,8 @@ def estimate_candidate_hbm(dec_cfg, config: Dict[str, Any], mesh,
         "offload_save_attn_out": 1.0, "offload_save_attn_kernel": 1.0,
         "save_attn_qkv": 2.0 + (dec_cfg.q_dim
                                 + 2 * dec_cfg.kv_heads * dec_cfg.head_dim) / d,
+        "save_attn_kernel_qkv": 2.0 + (
+            dec_cfg.q_dim + 2 * dec_cfg.kv_heads * dec_cfg.head_dim) / d,
         # no remat: everything lives until backward
         "none": 6.0 + act * 3.0 * ffn / d,
         "dots_saveable": 4.0 + act * 1.5 * ffn / d,

@@ -463,6 +463,14 @@ def resolve_remat_policy(name: Optional[str]):
         "save_attn_qkv":
             jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "qkv", "moe_dispatch", "moe_xs"),
+        # flash-kernel residuals AND post-rope q/k/v: backward re-runs
+        # neither the flash forward nor the qkv projections/rope —
+        # +(q+2kv)·Dh·2B per token of HBM on top of save_attn_kernel;
+        # measure per geometry (same eviction caveat as save_attn_qkv)
+        "save_attn_kernel_qkv":
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_kernel_out", "attn_lse", "qkv", "moe_dispatch",
+                "moe_xs"),
         # Host-DRAM activation offload — the reference's cpu_checkpointing
         # (runtime/activation_checkpointing/checkpointing.py partition/
         # cpu_checkpoint knobs). XLA emits async copy-start/copy-done pairs

@@ -286,20 +286,23 @@ def pick_blocks(d: int, f: int, itemsize: int = 2
     (DSTPU_GMM_BNF_BWD in :func:`_dgdu_rc`, DSTPU_GMM_BND_BWD in
     :func:`_dxs`).
     """
-    # defaults from the r5 on-chip sweep (1B/8e bench geometry, v5e):
-    # bnf 256 < 512 < 1024 < 1408 (13.5/13.9/15.5/17.6 ms per layer
-    # fwd+bwd) — small f-tiles re-read xs more but pipeline better and
-    # shrink the dgdu/dw accumulators; bm > 256 fails to compile and
-    # 128 only wins when paired with the losing bnf=1024
-    bnf = _block(f, int(os.environ.get("DSTPU_GMM_BNF", 256)))
+    # forward-kernel tiles (the backward sizes its own: _dgdu_rc /
+    # _dxs). bnf=1024 from the r5 trace: gate_up measured 3.1 ms/layer
+    # there vs 4.9 at 256 on the 1B/8e bench (the 256 sweep win predated
+    # the backward's independent knobs); bm > 256 fails to compile
+    bnf_env = int(os.environ.get("DSTPU_GMM_BNF", 0))
+    bnf = _block(f, bnf_env or 1024)
     bnd = _block(d, int(os.environ.get("DSTPU_GMM_BND", 512)))
     bm = int(os.environ.get("DSTPU_GMM_BM", 0)) or 256
     # dominant per-step footprint (gate_up kernel): xs + 2 weight blocks +
-    # 2 out blocks, double-buffered
-    while bm > 16:
-        step = (bm * d + 2 * d * bnf + 2 * bm * bnf) * itemsize * 2
-        if step <= _VMEM_BUDGET:
-            break
+    # 2 out blocks, double-buffered. The 2·d·bnf weight term is
+    # bm-INDEPENDENT, so big-d geometries must shrink bnf first (an
+    # explicit env bnf is honored as given); bm shrinks last.
+    step = lambda: (bm * d + 2 * d * bnf + 2 * bm * bnf) * itemsize * 2
+    if not bnf_env:
+        while bnf > 256 and step() > _VMEM_BUDGET:
+            bnf //= 2
+    while bm > 16 and step() > _VMEM_BUDGET:
         bm //= 2
     return bm, bnf, bnd
 
