@@ -330,6 +330,8 @@ class RaggedInferenceEngineTPU:
         self._step_fns: Dict[Any, Any] = {}
         #: fused decode-loop jit cache keyed on (n_bucket, steps, mode)
         self._fused_fns: Dict[Any, Any] = {}
+        #: jit for prefix-cache copy-on-write page duplication
+        self._copy_pages_fn = None
         self._rng_dev = rng          # defaulted to PRNGKey(0) above
         self._temperature = 1.0      # dynamic sampling scalars, packed
         self._top_p = 1.0            # into the step upload
@@ -483,6 +485,45 @@ class RaggedInferenceEngineTPU:
             if self.state.seqs[uid].pending == 0:
                 out[uid] = logits[i]
         return out
+
+    def step_with_budget(self, budget: Optional[int] = None, mode=("argmax",)
+                         ) -> Optional[Dict[int, Any]]:
+        """One engine step packing at most ``budget`` tokens (None → the
+        scheduler's max_batch_tokens). The serving frontend's entry point:
+        the SplitFuse policy installed on ``self.scheduler`` decides the
+        prefill/decode mix, this just runs whatever it packed. Returns
+        {uid: next_token_id} (or {uid: logits} with mode=None) for rows
+        whose pending tokens were exhausted; None when idle.
+        """
+        batch = self.scheduler.next_batch(budget=budget)
+        if batch is None:
+            return None
+        res = self._run(batch, mode=mode)
+        self.scheduler.mark_scheduled(batch)
+        out: Dict[int, Any] = {}
+        for i, uid in enumerate(batch.uids):
+            if self.state.seqs[uid].pending == 0:
+                out[uid] = res[i] if mode is None else int(res[i])
+        return out
+
+    def cow_block(self, src_block: int) -> int:
+        """Copy-on-write duplicate of one KV page across all layers.
+
+        Prefix-cache handout of a shared PARTIAL last page: the new owner
+        will append tokens into that page, so it gets a private copy; full
+        shared pages are aliased in the page table instead (no copy).
+        Returns the new physical page id (refcount 1, owned by caller).
+        """
+        dst = self.state.allocator.allocate(1)[0]
+        if self._copy_pages_fn is None:
+            self._copy_pages_fn = jax.jit(
+                partial(pa.copy_pages,
+                        num_layers=self.model_config.num_layers),
+                donate_argnums=(0,))
+        self.arena = self._copy_pages_fn(
+            self.arena, jnp.asarray([src_block], jnp.int32),
+            jnp.asarray([dst], jnp.int32))
+        return dst
 
     def _buckets(self, batch: RaggedBatch):
         nb = _bucket(len(batch.uids))

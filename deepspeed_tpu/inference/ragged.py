@@ -17,28 +17,67 @@ import numpy as np
 
 
 class BlockedAllocator:
-    """Fixed pool of KV pages (reference blocked_allocator.py:11)."""
+    """Fixed pool of REF-COUNTED KV pages (reference blocked_allocator.py:11).
+
+    Refcounts let one physical page back several logical owners at once —
+    the prefix cache (deepspeed_tpu/serving/prefix_cache.py) plus any
+    number of sequences whose prompts share that page. ``allocate`` hands
+    out pages at refcount 1; ``incref`` adds an owner; ``free`` drops one
+    owner and only returns the page to the pool when the LAST owner lets
+    go. Freeing a page nobody holds is a hard error (double free), not a
+    silent corruption of whoever re-allocated it.
+    """
 
     def __init__(self, num_blocks: int, block_size: int = 128):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._ref: List[int] = [0] * num_blocks
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        self._check(block)
+        return self._ref[block]
+
+    def _check(self, block: int) -> None:
+        if block < 0 or block >= self.num_blocks:
+            raise ValueError(f"bad block id {block}")
+
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise RuntimeError(
                 f"KV arena exhausted: want {n} blocks, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
 
-    def free(self, blocks: List[int]) -> None:
+    def incref(self, blocks: List[int]) -> None:
+        """Add an owner to live pages (prefix-cache sharing)."""
         for b in blocks:
-            if b < 0 or b >= self.num_blocks:
-                raise ValueError(f"bad block id {b}")
-            self._free.append(b)
+            self._check(b)
+            if self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"incref on free block {b}: the page is not live")
+            self._ref[b] += 1
+
+    def free(self, blocks: List[int]) -> int:
+        """Drop one owner per page; returns how many pages actually went
+        back to the pool (refcount reached zero)."""
+        released = 0
+        for b in blocks:
+            self._check(b)
+            if self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"double free of block {b}: the page has no owners")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                released += 1
+        return released
 
 
 @dataclass
@@ -86,6 +125,29 @@ class DSStateManager:
         seq.tokens.extend(new)
         return seq
 
+    def adopt(self, uid: int, token_ids, blocks: List[int],
+              seen_tokens: int) -> SequenceDescriptor:
+        """Create a sequence that starts life with pre-attached KV pages.
+
+        The prefix-cache handout path: ``blocks`` already hold the KV of
+        the first ``seen_tokens`` tokens of ``token_ids`` (the caller owns
+        one ref per page and that ref transfers to the sequence here, so
+        ``flush`` releases it). Pages for the uncached tail are allocated
+        as usual; if the arena is exhausted the sequence keeps its adopted
+        pages and the caller should ``flush(uid)`` to hand the refs back.
+        """
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already live; cannot adopt")
+        seq = self.get_or_create_sequence(uid)
+        seq.blocks.extend(blocks)
+        seq.seen_tokens = seen_tokens
+        try:
+            self.extend(uid, token_ids)
+        except RuntimeError:
+            self.flush(uid)
+            raise
+        return seq
+
     def flush(self, uid: int) -> None:
         """Release a finished sequence (reference engine_v2.py flush:242)."""
         seq = self.seqs.pop(uid, None)
@@ -120,33 +182,50 @@ class RaggedScheduler:
     reference inference/v2 engine put():107 semantics)."""
 
     def __init__(self, state: DSStateManager, max_batch_tokens: int = 2048,
-                 prefill_chunk: int = 512):
+                 prefill_chunk: int = 512, policy=None):
         self.state = state
         self.max_batch_tokens = max_batch_tokens
         self.prefill_chunk = prefill_chunk
+        # Optional selection policy: any object with
+        # ``select(state, budget, prefill_chunk) -> List[(uid, take)]``.
+        # None keeps the original insertion-order sweep. The serving layer
+        # plugs its SplitFuse token-budget policy in here
+        # (deepspeed_tpu/serving/scheduler.py) without the engine knowing.
+        self.policy = policy
 
     def put(self, uids, tokens_list) -> None:
         for uid, toks in zip(uids, tokens_list):
             self.state.extend(uid, toks)
 
-    def next_batch(self) -> Optional[RaggedBatch]:
-        uids, chunks, counts, starts, slots = [], [], [], [], []
-        budget = self.max_batch_tokens
+    def _default_select(self, budget: int) -> List[Tuple[int, int]]:
+        picks: List[Tuple[int, int]] = []
         for uid, seq in self.state.seqs.items():
             if seq.done or seq.pending == 0:
                 continue
             take = min(seq.pending, self.prefill_chunk, budget)
             if take <= 0:
                 continue
+            picks.append((uid, take))
+            budget -= take
+            if budget <= 0:
+                break
+        return picks
+
+    def next_batch(self, budget: Optional[int] = None) -> Optional[RaggedBatch]:
+        budget = self.max_batch_tokens if budget is None else budget
+        if self.policy is not None:
+            picks = self.policy.select(self.state, budget, self.prefill_chunk)
+        else:
+            picks = self._default_select(budget)
+        uids, chunks, counts, starts, slots = [], [], [], [], []
+        for uid, take in picks:
+            seq = self.state.seqs[uid]
             chunk = seq.tokens[seq.seen_tokens:seq.seen_tokens + take]
             uids.append(uid)
             chunks.append(chunk)
             counts.append(take)
             starts.append(seq.seen_tokens)
             slots.append(seq.slot)
-            budget -= take
-            if budget <= 0:
-                break
         if not uids:
             return None
         width = max(counts)

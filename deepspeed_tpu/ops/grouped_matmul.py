@@ -304,6 +304,14 @@ def pick_blocks(d: int, f: int, itemsize: int = 2
             bnf //= 2
     while bm > 16 and step() > _VMEM_BUDGET:
         bm //= 2
+    if bnf_env and step() > _VMEM_BUDGET:
+        # auto-sizing silently degrades; an explicit pin that cannot fit
+        # even at the floor bm must fail loudly instead of OOMing VMEM
+        # deep inside Mosaic with an unrelated-looking error
+        raise ValueError(
+            f"DSTPU_GMM_BNF={bnf_env} needs {step()} bytes of VMEM for "
+            f"the gate_up tiles at d={d} (> {_VMEM_BUDGET} budget) even "
+            f"at bm={bm}; lower the override")
     return bm, bnf, bnd
 
 
@@ -698,18 +706,35 @@ def _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd, interpret):
     DSTPU_GMM_BND_BWD overrides the d-tile (default 512 → 2 sweeps)."""
     r_pad, f = dg.shape
     d = wg.shape[1]
+    bnd_env = int(os.environ.get("DSTPU_GMM_BND_BWD", 0))
     if bm > 128 and bm % 128 == 0:
         bm_x = 128
         sub = bm // bm_x
         g_x = jnp.repeat(g_of_tile, sub)
         lt_x = live_tiles * sub
-        bnd = _block(d, int(os.environ.get("DSTPU_GMM_BND_BWD", 512)))
+        bnd = _block(d, bnd_env or 512)
     else:
         # bm not 128-divisible: sub-tiles would straddle expert
         # boundaries — keep whole m-tiles and halve the d-tile for VMEM
         # (the pre-subdivision behavior)
         bm_x, g_x, lt_x = bm, g_of_tile, live_tiles
         bnd = max(_LANE, bnd // 2)
+        bnd_env = 0          # the override only governs the 128-sub path
+    # per-step footprint, double-buffered: dg + du rows (bm_x, f), two
+    # full-f weight d-slices (bnd, f), one out block (bm_x, bnd). The
+    # 2·bnd·f weight term scales with f, so long-ffn geometries must
+    # clamp bnd the same way pick_blocks clamps bnf
+    itemsize = dg.dtype.itemsize
+    step = lambda: (2 * bm_x * f + 2 * bnd * f + bm_x * bnd) * itemsize * 2
+    if bnd_env:
+        if step() > _VMEM_BUDGET:
+            raise ValueError(
+                f"DSTPU_GMM_BND_BWD={bnd_env} needs {step()} bytes of "
+                f"VMEM for the dxs tiles at f={f} (> {_VMEM_BUDGET} "
+                f"budget); lower the override")
+    else:
+        while bnd > _LANE and step() > _VMEM_BUDGET:
+            bnd //= 2
     grid = (pl.cdiv(d, bnd), r_pad // bm_x)
     specs = [
         pl.BlockSpec((bm_x, f), lambda j, i, g, lt: (i, 0)),
@@ -739,6 +764,17 @@ def _dw_ragged(lhs, grad, sizes_padded, num_experts):
     if os.environ.get("DSTPU_GMM_DW") == "zero":
         return jnp.zeros((num_experts, lhs.shape[1], grad.shape[1]),
                          lhs.dtype)
+    if not hasattr(lax, "ragged_dot_general"):
+        # older jax: no ragged-CONTRACTION primitive — fall back to a
+        # segment-masked einsum (exact: padding rows are zero in both
+        # operands; rows past the total land in no segment)
+        ends = jnp.cumsum(sizes_padded)
+        row = jnp.arange(lhs.shape[0], dtype=ends.dtype)[:, None]
+        seg = ((row >= ends - sizes_padded) & (row < ends)
+               ).astype(jnp.float32)                     # [R, E]
+        return jnp.einsum("re,rd,rf->edf", seg,
+                          lhs.astype(jnp.float32),
+                          grad.astype(jnp.float32)).astype(lhs.dtype)
     dims = lax.RaggedDotDimensionNumbers(
         dot_dimension_numbers=(((0,), (0,)), ((), ())),
         lhs_ragged_dimensions=[0], rhs_group_dimensions=[])
@@ -856,7 +892,14 @@ def _build_ffn_w(bm: int, bnf: int, bnd: int, interpret: bool):
             # to expose the combine-weight-grad cost
             dw2 = jnp.zeros_like(w2)
         else:
-            dw2 = jnp.sum(dwp, axis=0).astype(w2.dtype)   # [nm, 1, bm]
+            # dwp m-tiles at/past live_tiles are SKIPPED by the kernel
+            # (uninitialized memory) — mask them before handing the
+            # combine-weight grad to the optimizer, or garbage/NaNs in
+            # the dead tail poison the router update
+            tile = jnp.arange(dwp.shape[1], dtype=jnp.int32)[:, None, None]
+            dw2 = jnp.where(tile < live_tiles[0],
+                            jnp.sum(dwp, axis=0), 0.0
+                            ).astype(w2.dtype)            # [nm, 1, bm]
         dxs = _dxs(dg, du, wg, wi, g_of_tile, live_tiles, bm, bnd,
                    interpret)
         dw_mode = os.environ.get("DSTPU_GMM_DW", "pallas")

@@ -96,6 +96,30 @@ def write_kv(arena_k: jax.Array, arena_v: jax.Array, k: jax.Array,
     return arena_k, arena_v
 
 
+def copy_pages(arena: dict, src: jax.Array, dst: jax.Array,
+               num_layers: int) -> dict:
+    """Copy whole KV pages ``src[i] → dst[i]`` across every layer's region.
+
+    The copy-on-write half of prefix caching: page tables are plain
+    physical-id arrays, so several uids may reference the SAME page
+    (full shared-prefix pages need no copy at all — the per-sequence
+    ``starts``/``counts`` masking already keeps each row's reads inside
+    its own context). Only a shared *partial* last page must be
+    duplicated before its new owner appends into it, which is this op:
+    one gather+scatter over the flat pool per {k, v}.
+
+    arena: {"k","v"} flat pools [kvh, L*(nb+1), bs, dh]; src/dst: [m]
+    logical page ids (< nb, layer-relative).
+    """
+    k = arena["k"]
+    stride = k.shape[1] // num_layers            # nb + 1
+    offs = jnp.arange(num_layers, dtype=jnp.int32)[:, None] * stride
+    s = (offs + jnp.asarray(src, jnp.int32)[None, :]).reshape(-1)
+    d = (offs + jnp.asarray(dst, jnp.int32)[None, :]).reshape(-1)
+    return {"k": k.at[:, d].set(k[:, s]),
+            "v": arena["v"].at[:, d].set(arena["v"][:, s])}
+
+
 # ---------------------------------------------------------------------------
 # XLA reference path (also the prefill path)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,284 @@
+"""ServingFrontend — the single-threaded serving pump.
+
+Owns the admission queue, the prefix cache, the SplitFuse policy and the
+metrics, and drives :meth:`RaggedInferenceEngineTPU.step_with_budget` in a
+loop. Single-threaded by design (T3-style: all host scheduling happens
+while the device runs the previous step's program; a thread pool would
+only add locks to a loop whose wall clock is the device's).
+
+Request path: ``submit`` → bounded queue (reject ``queue_full`` /
+``kv_exhausted`` / ``too_long``) → admission matches the prompt against
+the radix prefix cache, aliases shared full pages (incref), copy-on-writes
+a shared partial page, and adopts the sequence with ``seen_tokens``
+already covering the cached span → SplitFuse packs prefill + decode under
+the token budget → per-token stream callbacks → flush + cache insert.
+"""
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
+from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue
+from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.serving.scheduler import TokenBudgetPolicy
+
+
+def adopt_cached(engine, cache, uid: int, prompt: List[int]) -> int:
+    """Admit ``prompt`` as sequence ``uid``, reusing cached prefix pages.
+
+    Matches the prompt against the radix cache, aliases shared FULL pages
+    (incref — the ref transfers to the sequence), duplicates a shared
+    partial page copy-on-write, and adopts the sequence with
+    ``seen_tokens`` covering the reused span; the match is capped at
+    ``len(prompt) - 1`` so at least one token prefills and produces this
+    request's own logits. Evicts cache LRU pages if the arena can't fit
+    the uncached tail (never the pages being handed out). Returns the
+    number of prompt tokens served from the cache; raises RuntimeError
+    when the arena cannot fit even after eviction (nothing is leaked).
+    """
+    alloc = engine.state.allocator
+    bs = alloc.block_size
+    aliased: List[int] = []
+    cow_src = None
+    matched = 0
+    if cache is not None:
+        m = cache.match(prompt)
+        matched = min(m.matched(bs), len(prompt) - 1)
+        full_keep = matched // bs
+        aliased = m.full_blocks[:full_keep]
+        if matched > full_keep * bs:
+            # tail of the match lives mid-page → hand that page out
+            # copy-on-write (a capped FULL page counts too: its new owner
+            # re-prefills into it)
+            cow_src = (m.full_blocks[full_keep]
+                       if full_keep < len(m.full_blocks)
+                       else m.partial_block)
+        else:
+            matched = full_keep * bs
+    need = -(-len(prompt) // bs) - len(aliased)
+    if need > alloc.free_blocks and cache is not None:
+        cache.evict(need - alloc.free_blocks,
+                    exclude_blocks=aliased + [cow_src])
+    if need > alloc.free_blocks:
+        raise RuntimeError(
+            f"KV arena exhausted: want {need} blocks, "
+            f"{alloc.free_blocks} free")
+    adopted = list(aliased)
+    if aliased:
+        alloc.incref(aliased)
+    if cow_src is not None:
+        try:
+            adopted.append(engine.cow_block(cow_src))
+        except RuntimeError:
+            if aliased:
+                alloc.free(aliased)
+            raise
+    engine.state.adopt(uid, prompt, adopted, matched)
+    return matched
+
+
+class ServingFrontend:
+
+    def __init__(self, engine, max_queue: int = 128,
+                 enable_prefix_cache: bool = True,
+                 cache_pages: Optional[int] = None,
+                 monitor=None, mode=("argmax",),
+                 token_budget: Optional[int] = None,
+                 emit_every: int = 0, clock=time.monotonic):
+        self.engine = engine
+        self.policy = TokenBudgetPolicy()
+        engine.scheduler.policy = self.policy
+        self.queue = AdmissionQueue(max_queue)
+        self.cache = (PrefixCache(engine.state.allocator, cache_pages)
+                      if enable_prefix_cache else None)
+        self.metrics = ServingMetrics()
+        self.monitor = monitor
+        self.mode = mode
+        self.token_budget = token_budget     # None → engine max_batch_tokens
+        self.emit_every = emit_every
+        self.clock = clock                   # injectable for deadline tests
+        self._running: Dict[int, Request] = {}
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 16, priority: int = 0,
+               timeout: Optional[float] = None,
+               deadline: Optional[float] = None,
+               stream_cb=None) -> Request:
+        """Admit a request or raise :class:`AdmissionError` with a reason
+        (``queue_full`` | ``kv_exhausted`` | ``too_long``) — overload is
+        surfaced at the door, not buffered into unbounded latency."""
+        now = self.clock()
+        prompt = [int(t) for t in prompt]
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      priority=priority, stream_cb=stream_cb,
+                      deadline=(now + timeout if timeout is not None
+                                else deadline))
+        total = len(prompt) + req.max_new_tokens
+        if not prompt or total > self.engine.config.max_seq_len:
+            req.state = RequestState.REJECTED
+            req.finish_reason = "too_long"
+            self.metrics.bump("rejected_too_long")
+            raise AdmissionError(
+                "too_long", f"{total} tokens vs max_seq_len="
+                f"{self.engine.config.max_seq_len}")
+        bs = self.engine.state.allocator.block_size
+        need = -(-total // bs)
+        avail = self.engine.state.allocator.free_blocks + \
+            (self.cache.evictable_pages() if self.cache else 0)
+        if need > avail:
+            req.state = RequestState.REJECTED
+            req.finish_reason = "kv_exhausted"
+            self.metrics.bump("rejected_kv_exhausted")
+            raise AdmissionError(
+                "kv_exhausted", f"need {need} pages, {avail} reclaimable")
+        try:
+            self.queue.submit(req, now)
+        except AdmissionError:
+            self.metrics.bump("rejected_queue_full")
+            raise
+        self.metrics.bump("admitted")
+        return req
+
+    def cancel(self, req: Request) -> None:
+        req.cancel()
+
+    def _try_admit_one(self, now: float) -> bool:
+        eng = self.engine
+        req = self.queue.pop_next(now)
+        if req is None:
+            return False
+        if len(eng.state.seqs) >= eng.config.max_sequences:
+            self.queue._q.insert(0, req)
+            return False
+        try:
+            matched = adopt_cached(eng, self.cache, req.uid, req.prompt)
+        except RuntimeError:
+            # arena can't fit yet (nothing leaked) — retry when running
+            # sequences finish and release pages
+            self.queue._q.insert(0, req)
+            return False
+        self.policy.note_arrival(req.uid)
+        req.state = RequestState.RUNNING
+        req.schedule_ts = now
+        req.cached_tokens = matched
+        if matched:
+            self.metrics.bump("prefix_tokens_reused", matched)
+        self._running[req.uid] = req
+        return True
+
+    # -- the pump -----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One pump iteration: shed → cancel → admit → engine step →
+        fan tokens out. Returns True while there is (or was) work."""
+        now = self.clock()
+        progressed = False
+        for r in self.queue.shed_expired(now):
+            self.metrics.bump("shed")
+            progressed = True
+        for uid, req in list(self._running.items()):
+            if req.cancelled:
+                self._finish(req, "cancelled", RequestState.CANCELLED, now)
+                progressed = True
+            elif req.expired(now):
+                self._finish(req, "deadline", RequestState.SHED, now)
+                self.metrics.bump("shed")
+                progressed = True
+        while self._try_admit_one(now):
+            progressed = True
+        self.metrics.queue_depth.record(float(len(self.queue)))
+        out = self.engine.step_with_budget(budget=self.token_budget,
+                                           mode=self.mode)
+        if out is None:
+            return progressed or bool(self._running or len(self.queue))
+        self.metrics.bump("engine_steps")
+        now = self.clock()
+        for uid, tok in out.items():
+            req = self._running.get(uid)
+            if req is None:
+                continue
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                self.metrics.ttft.record(now - (req.enqueue_ts or now))
+                if self.cache is not None:
+                    # prefill done → every prompt page holds valid KV;
+                    # publish them (cache increfs what it keeps)
+                    self.cache.insert(
+                        req.prompt, self.engine.state.seqs[uid].blocks)
+            tok = int(tok)
+            req.tokens_out.append(tok)
+            self.metrics.bump("tokens_out")
+            if req.stream_cb is not None:
+                req.stream_cb(tok)
+            if len(req.tokens_out) >= req.max_new_tokens:
+                self._finish(req, "length", RequestState.FINISHED, now)
+            else:
+                try:
+                    self.engine.state.extend(uid, [tok])
+                except RuntimeError:
+                    if self.cache is not None and self.cache.evict(1):
+                        self.engine.state.extend(uid, [tok])
+                    else:
+                        self._finish(req, "kv_exhausted",
+                                     RequestState.FINISHED, now)
+        if self.emit_every and self.metrics.counters["engine_steps"] % \
+                self.emit_every == 0:
+            self.emit_metrics()
+        return True
+
+    def _finish(self, req: Request, reason: str, state: RequestState,
+                now: float) -> None:
+        self.engine.flush(req.uid)
+        self.policy.forget(req.uid)
+        self._running.pop(req.uid, None)
+        req.state = state
+        req.finish_reason = reason
+        req.finish_ts = now
+        if req.tpot is not None:
+            self.metrics.tpot.record(req.tpot)
+        if state is RequestState.FINISHED:
+            self.metrics.bump("completed")
+        elif state is RequestState.CANCELLED:
+            self.metrics.bump("cancelled")
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        """Pump until every admitted request reached a terminal state."""
+        for _ in range(max_steps):
+            if not (self._running or len(self.queue)):
+                return
+            self.step()
+        raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
+
+    def stream(self, req: Request) -> Iterator[int]:
+        """Yield ``req``'s tokens as they are produced, driving the pump
+        between yields (single-threaded streaming iterator)."""
+        emitted = 0
+        stall = 0
+        while True:
+            while emitted < len(req.tokens_out):
+                yield req.tokens_out[emitted]
+                emitted += 1
+            if req.done:
+                return
+            stall = stall + 1 if not self.step() else 0
+            if stall > 10000:
+                raise RuntimeError(
+                    f"stream stalled: request {req.uid} in {req.state}")
+
+    def emit_metrics(self, step: Optional[int] = None) -> None:
+        self.metrics.emit(self.monitor, self.cache,
+                          step if step is not None
+                          else self.metrics.counters["engine_steps"])
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = dict(self.metrics.counters)
+        out["ttft"] = self.metrics.ttft.summary()
+        out["tpot"] = self.metrics.tpot.summary()
+        out["queue_depth"] = len(self.queue)
+        out["running"] = len(self._running)
+        if self.cache is not None:
+            out["prefix_hit_rate"] = self.cache.hit_rate
+            out["prefix_pages_cached"] = self.cache.pages_cached
+        return out

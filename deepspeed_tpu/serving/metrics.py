@@ -1,0 +1,97 @@
+"""Serving observability: latency histograms → monitor events.
+
+TTFT (time-to-first-token), TPOT (time-per-output-token), queue depth and
+prefix-cache hit rate are the four numbers an operator actually pages on;
+they are kept as fixed-bucket histograms host-side (no device traffic) and
+flushed through :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster` as
+``serving/*`` events so whatever writer stack training already configured
+(TensorBoard/W&B/Comet/CSV) picks them up unchanged.
+"""
+
+import bisect
+import math
+from typing import Dict, List, Optional, Tuple
+
+
+class Histogram:
+    """Fixed log-spaced buckets; O(log B) record, exact count/sum."""
+
+    def __init__(self, lo: float = 1e-4, hi: float = 100.0,
+                 n_buckets: int = 40):
+        ratio = (hi / lo) ** (1.0 / (n_buckets - 1))
+        self.bounds = [lo * ratio ** i for i in range(n_buckets)]
+        self.counts = [0] * (n_buckets + 1)
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+
+    def record(self, v: float) -> None:
+        if not math.isfinite(v):
+            return
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket holding the p-th percentile sample."""
+        if not self.count:
+            return 0.0
+        target = p / 100.0 * self.count
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return self.bounds[min(i, len(self.bounds) - 1)]
+        return self.bounds[-1]
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean,
+                "p50": self.percentile(50), "p99": self.percentile(99),
+                "min": self.vmin or 0.0, "max": self.vmax or 0.0}
+
+
+class ServingMetrics:
+    """Aggregates the frontend's counters + histograms and emits them."""
+
+    def __init__(self):
+        self.ttft = Histogram()
+        self.tpot = Histogram(lo=1e-5, hi=10.0)
+        self.queue_depth = Histogram(lo=1.0, hi=4096.0, n_buckets=13)
+        self.counters: Dict[str, int] = {
+            "admitted": 0, "completed": 0, "cancelled": 0, "shed": 0,
+            "rejected_queue_full": 0, "rejected_kv_exhausted": 0,
+            "rejected_too_long": 0, "tokens_out": 0,
+            "prefix_tokens_reused": 0, "engine_steps": 0,
+        }
+
+    def bump(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def events(self, cache=None, step: int = 0
+               ) -> List[Tuple[str, float, int]]:
+        ev: List[Tuple[str, float, int]] = []
+        for key, h in (("ttft", self.ttft), ("tpot", self.tpot),
+                       ("queue_depth", self.queue_depth)):
+            if h.count:
+                ev.append((f"serving/{key}_mean", h.mean, step))
+                ev.append((f"serving/{key}_p99", h.percentile(99), step))
+        for name, val in self.counters.items():
+            ev.append((f"serving/{name}", float(val), step))
+        if cache is not None:
+            ev.append(("serving/prefix_hit_rate", cache.hit_rate, step))
+            ev.append(("serving/prefix_pages_cached",
+                       float(cache.pages_cached), step))
+        return ev
+
+    def emit(self, monitor, cache=None, step: int = 0) -> None:
+        """Flush to a MonitorMaster (no-op when monitoring is disabled)."""
+        if monitor is None or not getattr(monitor, "enabled", False):
+            return
+        monitor.write_events(self.events(cache, step))

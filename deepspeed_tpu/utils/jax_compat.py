@@ -1,0 +1,39 @@
+"""JAX version-compatibility shims.
+
+The framework targets the stable ``jax.shard_map`` spelling (jax >= 0.5
+moved it out of ``jax.experimental`` and renamed ``check_rep`` →
+``check_vma``, ``auto`` → its complement ``axis_names``). On older
+jaxlibs, :func:`install` aliases an adapter under ``jax.shard_map`` that
+translates the new keyword surface to the experimental one, so every call
+site (and tests) can use one spelling regardless of the installed jax.
+"""
+
+import inspect
+import os
+
+import jax
+
+
+def install() -> None:
+    if os.environ.get("DSTPU_NO_JAX_COMPAT"):     # escape hatch
+        return
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if "check_vma" in inspect.signature(_sm).parameters:
+        jax.shard_map = _sm
+        return
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        if axis_names is not None and mesh is not None:
+            # new API names the MANUAL axes; old API names the AUTO rest
+            kw.setdefault("auto", frozenset(mesh.axis_names) -
+                          frozenset(axis_names))
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+
+    jax.shard_map = shard_map

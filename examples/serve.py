@@ -2,6 +2,11 @@
 FastGen analogue) or the simpler padded v1 engine.
 
     python examples/serve.py --engine ragged --prompts "hello" "the sky"
+
+``--stream`` routes the ragged engine through the serving frontend
+(deepspeed_tpu/serving/): prefix-cached admission, SplitFuse token-budget
+scheduling and per-token streaming; ``--concurrency`` caps how many
+requests are in flight at once (the rest wait in the admission queue).
 """
 
 import argparse
@@ -21,6 +26,12 @@ def main():
                     default=None,
                     help="weight-only quantized serving (half or quarter "
                          "the weight HBM; ops/quantized_linear.py)")
+    ap.add_argument("--stream", action="store_true",
+                    help="drive the ServingFrontend and print tokens as "
+                         "they are produced (ragged engine only)")
+    ap.add_argument("--concurrency", type=int, default=0,
+                    help="with --stream: max requests in flight at once "
+                         "(0 = engine max_sequences)")
     args = ap.parse_args()
 
     from _common import setup_jax
@@ -50,7 +61,31 @@ def main():
     eng_cfg = {}
     if args.weight_quant:
         eng_cfg["weight_quant"] = args.weight_quant
-    if args.engine == "ragged":
+    if args.stream:
+        from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
+        from deepspeed_tpu.serving import ServingFrontend
+        eng = RaggedInferenceEngineTPU(cfg, eng_cfg or None, params=params)
+        if args.concurrency:
+            eng.config.max_sequences = min(eng.config.max_sequences,
+                                           args.concurrency)
+        fe = ServingFrontend(eng)
+
+        def cb_for(i):
+            def cb(t):
+                piece = tok.decode([t]) if tok is not None else str(t)
+                print(f"[{i}] {piece}", flush=True)
+            return cb
+
+        reqs = [fe.submit(p, max_new_tokens=args.max_new_tokens,
+                          stream_cb=cb_for(i))
+                for i, p in enumerate(prompts)]
+        fe.run_until_idle()
+        outs = [r.tokens_out for r in reqs]
+        stats = fe.stats()
+        print(f"# engine_steps={stats['engine_steps']} "
+              f"prefix_hit_rate={stats.get('prefix_hit_rate', 0.0):.2f} "
+              f"ttft_mean={stats['ttft']['mean']:.4f}s")
+    elif args.engine == "ragged":
         from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
         eng = RaggedInferenceEngineTPU(cfg, eng_cfg or None, params=params)
         outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens,

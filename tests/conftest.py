@@ -32,11 +32,46 @@ if "xla_cpu_collective" not in _flags:
     _flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
                " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
                " --xla_cpu_collective_timeout_seconds=1200")
+
+
+def _flags_ok(flags: str) -> bool:
+    """XLA ABORTS the whole process on flags this jaxlib doesn't know
+    (parse_flags_from_env.cc CHECK) — probe in a throwaway subprocess so
+    an older/newer jaxlib degrades to fewer tuning flags instead of
+    killing the suite at the first backend init."""
+    import subprocess
+    import sys
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
+            capture_output=True, timeout=120)
+        return probe.returncode == 0
+    except Exception:
+        return False
+
+
+if not _flags_ok(_flags):
+    # drop the collective-timeout trio first (newest flags), then the
+    # scheduler knob; the device-count flag is load-bearing and old
+    _flags = " ".join(f for f in _flags.split()
+                      if "xla_cpu_collective" not in f)
+    if not _flags_ok(_flags):
+        _flags = " ".join(
+            f for f in _flags.split()
+            if "xla_cpu_enable_concurrency_optimized_scheduler" not in f)
 os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# jax < 0.5 compat: tests (and the framework) use the stable
+# ``jax.shard_map`` spelling; install the adapter before any test module's
+# ``from jax import shard_map`` runs
+from deepspeed_tpu.utils import jax_compat  # noqa: E402
+
+jax_compat.install()
 
 import pytest  # noqa: E402
 
@@ -59,12 +94,21 @@ def mesh8(devices):
 # CPU compiles of near-identical tiny programs; caching them across runs
 # (and across tests in one run) cuts a cold ~50 min suite to the warm
 # execution time. Safe to share: keys include jaxlib version + flags.
-_cache_dir = os.environ.get(
-    "DSTPU_TEST_CACHE", os.path.join(os.path.dirname(__file__), "..",
-                                     ".jax_test_cache"))
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+#
+# jaxlib 0.4.x: DESERIALIZING cached CPU executables intermittently
+# corrupts the heap (double-free-style aborts/segfaults surfacing later
+# in unrelated device_puts — reproduced ~80% warm on the elastic-resume
+# flow, never cold). Reads are the broken half, so the cache must stay
+# off entirely there — a cold-written cache would poison the NEXT run.
+_jax_minor = tuple(int(x) for x in jax.__version__.split(".")[:2])
+if _jax_minor >= (0, 5):
+    _cache_dir = os.environ.get(
+        "DSTPU_TEST_CACHE", os.path.join(os.path.dirname(__file__), "..",
+                                         ".jax_test_cache"))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -39,6 +39,14 @@ def test_long_context_example():
     assert "sp=4 ulysses" in r.stdout
 
 
+def test_serve_stream_example():
+    r = _run(["examples/serve.py", "--stream", "--concurrency", "2",
+              "--prompts", "1 2 3 4", "1 2 3 9", "--max-new-tokens", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "[0]" in r.stdout and "[1]" in r.stdout   # per-token stream
+    assert "engine_steps=" in r.stdout               # frontend stats line
+
+
 def test_serve_v1_example():
     r = _run(["examples/serve.py", "--engine", "v1", "--prompts", "1 2 3",
               "--max-new-tokens", "4"])

@@ -225,3 +225,25 @@ def test_supported_gate():
     assert supported(128, 256)
     assert not supported(100, 256)
     assert not supported(128, 200)
+
+
+def test_pick_blocks_rejects_oversized_bnf_override(monkeypatch):
+    """An explicit DSTPU_GMM_BNF that cannot fit the VMEM budget even at
+    the bm floor must raise (naming the knob), not OOM inside Mosaic."""
+    monkeypatch.setenv("DSTPU_GMM_BNF", str(1 << 20))
+    with pytest.raises(ValueError, match="DSTPU_GMM_BNF"):
+        pick_blocks(4096, 1 << 20)
+
+
+def test_dxs_rejects_oversized_bnd_bwd_override(monkeypatch):
+    """Same contract for the backward d-tile knob: the guard fires
+    before any kernel launch."""
+    from deepspeed_tpu.ops.grouped_matmul import _dxs
+    monkeypatch.setenv("DSTPU_GMM_BND_BWD", str(1 << 20))
+    dg = jnp.zeros((256, 4096), jnp.float32)   # big f → weight d-slices
+    wg = jnp.zeros((2, 256, 4096), jnp.float32)  # dominate the budget
+    g_of_tile = jnp.zeros((1,), jnp.int32)
+    live = jnp.ones((1,), jnp.int32)
+    with pytest.raises(ValueError, match="DSTPU_GMM_BND_BWD"):
+        _dxs(dg, dg, wg, wg, g_of_tile, live, bm=256, bnd=512,
+             interpret=True)
