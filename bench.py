@@ -29,20 +29,10 @@ import numpy as np
 
 
 def _peak_flops(device) -> float:
-    """bf16 peak FLOPs/s per chip by device kind (public TPU specs)."""
-    kind = getattr(device, "device_kind", "cpu").lower()
-    table = {
-        "v6e": 918e12, "trillium": 918e12,
-        "v5p": 459e12,
-        "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
-        "v4": 275e12,
-        "v3": 123e12,
-        "v2": 45e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 0.0   # CPU / unknown: MFU not meaningful
+    """bf16 peak FLOPs/s per chip (the table lives in telemetry.sampler;
+    imported lazily so bench argparse stays jax-free)."""
+    from deepspeed_tpu.telemetry.sampler import peak_flops
+    return peak_flops(device)
 
 
 def _run_sub(cmd, timeout):
@@ -195,6 +185,9 @@ def moe_main(args) -> None:
                   "loss": loss_val, "platform": dev0.platform,
                   "n_devices": n_dev, "steps": steps,
                   "global_batch": gb}}))
+    if getattr(args, "trace", None):
+        from deepspeed_tpu.telemetry import tracer
+        tracer.dump(args.trace)
 
 
 def main() -> None:
@@ -205,15 +198,24 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--mode", default="dense", choices=("dense", "moe"))
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="record host-side spans and dump Chrome trace-event"
+                         " JSON here (inspect with bin/dstpu-trace or "
+                         "ui.perfetto.dev)")
     args = ap.parse_args()
 
+    if args.trace:
+        from deepspeed_tpu.telemetry import tracer
+        tracer.configure(enabled=True)
     if args.mode == "moe":
         moe_main(args)
         return
     # run the full suite only on the driver-style bare invocation — explicit
-    # --seq/--batch/--steps runs are themselves sub-benchmarks or tuning
+    # --seq/--batch/--steps/--trace runs are themselves sub-benchmarks or
+    # tuning/profiling runs
     run_suite = (args.seq is None and args.batch is None
                  and args.steps is None and args.size is None
+                 and args.trace is None
                  and os.environ.get("DSTPU_BENCH_SUITE", "1") != "0")
 
     import jax
@@ -376,6 +378,9 @@ def main() -> None:
                 "length is 1M tokens of global context)"),
         }
     print(json.dumps(result))
+    if args.trace:
+        from deepspeed_tpu.telemetry import tracer
+        tracer.dump(args.trace)
 
 
 if __name__ == "__main__":

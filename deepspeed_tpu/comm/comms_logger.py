@@ -66,6 +66,16 @@ class CommsLogger:
         rec = self.comms_dict[op_name][size_bytes]
         rec[0] += 1
         rec[1] += time_sec
+        # mirror into the unified telemetry spine: a trace-time instant
+        # (these fire while jax traces, not while the collective runs —
+        # ph='X' with a wall-clock dur would be a lie) plus byte counters
+        from deepspeed_tpu.telemetry import registry, tracer
+        tracer.instant(f"comm/{op_name}", bytes=size_bytes,
+                       axis=str(axis) if axis is not None else None)
+        registry.counter("comm/bytes",
+                         help="bytes entering collectives (trace-time)"
+                         ).inc(max(0, size_bytes))
+        registry.counter(f"comm/{op_name}/calls").inc()
         if self.verbose:
             logger.info("comm op: %s | size: %s | axis: %s", op_name,
                         convert_size(size_bytes), axis)

@@ -339,6 +339,25 @@ class FlopsProfilerConfig(TPUConfigModel):
     output_file: Optional[str] = None
 
 
+class TelemetryConfig(TPUConfigModel):
+    """``"telemetry"`` block → deepspeed_tpu/telemetry (tracer + registry +
+    samplers). Metrics recording is always on (cheap, process-wide
+    registry); this block controls span *tracing* and its export."""
+    enabled: bool = False
+    #: ring-buffer capacity; oldest spans evicted beyond this
+    trace_buffer_events: int = Field(default=100_000, ge=1)
+    #: dump Chrome trace-event JSON here at engine destruction / bench exit
+    trace_file: Optional[str] = None
+    #: enter jax.profiler TraceAnnotation/StepTraceAnnotation per span so
+    #: names line up inside a real profiler capture
+    jax_annotations: bool = False
+    #: sample device/host memory gauges on monitor flushes
+    sample_memory: bool = True
+    #: override the per-chip peak FLOPs/s used for MFU (0/None → auto
+    #: from the device kind; CPU has no peak, so MFU reads 0 there)
+    peak_flops_override: Optional[float] = Field(default=None, gt=0)
+
+
 class TensorBoardConfig(TPUConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -462,6 +481,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
 
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)

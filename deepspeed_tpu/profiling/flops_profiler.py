@@ -65,6 +65,16 @@ class FlopsProfiler:
             flops = self.engine.model.flops_per_token * tokens
             result["tflops"] = flops / max(dt, 1e-9) / 1e12
             self.last_tflops = result["tflops"]
+            # interval MFU through the shared peak-FLOPs table; unlike the
+            # engine's per-step host-time gauge this window is explicitly
+            # opened/closed by the caller, so it can bracket a synced region
+            from deepspeed_tpu.telemetry import registry
+            from deepspeed_tpu.telemetry.sampler import mfu
+            result["mfu"] = mfu(flops, dt, n_devices=jax.device_count())
+            registry.gauge(
+                "train/mfu_profiled",
+                help="MFU over the last start/stop_profile window").set(
+                result["mfu"])
         return result
 
     def print_profile(self) -> None:

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu import comm
+from deepspeed_tpu import comm, telemetry
 from deepspeed_tpu.config import DeepSpeedTPUConfig
 from deepspeed_tpu.ops.optimizers import Optimizer, build_optimizer
 from deepspeed_tpu.parallel.mesh import (ZERO_AXES, build_mesh,
@@ -217,6 +217,7 @@ class DeepSpeedTPUEngine:
         self._monitor_pending = []
         self.training_dataloader = self._build_dataloader(training_data)
         self.lr_scheduler = self.lr_schedule   # parity name
+        self._init_telemetry()
 
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype="
@@ -595,10 +596,13 @@ class DeepSpeedTPUEngine:
             raise RuntimeError(
                 "forward()/backward()/step() are not supported under "
                 "offload_param (layer-streamed schedule); use train_batch()")
+        if self._step_t0 is None:           # first micro of the window
+            self._step_t0 = telemetry.tracer.now()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._place_batch(batch)
-        loss, grads = self._grad_step(self.params, batch,
-                                      self.loss_scale_state.scale, sub)
+        with telemetry.tracer.span("train/forward", step=self.global_steps):
+            loss, grads = self._grad_step(self.params, batch,
+                                          self.loss_scale_state.scale, sub)
         self._pending_grads = grads
         self._pending_loss = loss
         return loss
@@ -607,12 +611,13 @@ class DeepSpeedTPUEngine:
         """Fold pending grads into the accumulator (reference engine.py:2478)."""
         if getattr(self, "_pending_grads", None) is None:
             raise RuntimeError("backward() called without forward()")
-        if self._acc_grads is None:
-            self._acc_grads = jax.tree.map(
-                lambda g: g.astype(jnp.float32), self._pending_grads)
-        else:
-            self._acc_grads = self._acc_add(self._acc_grads,
-                                            self._pending_grads)
+        with telemetry.tracer.span("train/backward", step=self.global_steps):
+            if self._acc_grads is None:
+                self._acc_grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32), self._pending_grads)
+            else:
+                self._acc_grads = self._acc_add(self._acc_grads,
+                                                self._pending_grads)
         self._pending_grads = None
         self.micro_steps += 1
         return loss
@@ -625,24 +630,29 @@ class DeepSpeedTPUEngine:
         if self._acc_grads is None:
             raise RuntimeError("step() called with no accumulated gradients")
         if self.offload_enabled:
-            grads = jax.tree.map(lambda g: g / gas, self._acc_grads)
-            metrics = self._host_step(grads)
+            with telemetry.tracer.span("train/optimizer",
+                                       step=self.global_steps):
+                grads = jax.tree.map(lambda g: g / gas, self._acc_grads)
+                metrics = self._host_step(grads)
             self._acc_grads = None
             self.global_steps += 1
             self.global_samples += int(self.config.train_batch_size)
             self._last_metrics = metrics
+            self._close_step_span()
             self._write_monitor(metrics)
             return
-        self.params, self.opt_state, self.loss_scale_state, metrics = \
-            self._update_step(self.params, self.opt_state,
-                              self.loss_scale_state, self._acc_grads,
-                              jnp.int32(self.global_steps))
+        with telemetry.tracer.span("train/optimizer", step=self.global_steps):
+            self.params, self.opt_state, self.loss_scale_state, metrics = \
+                self._update_step(self.params, self.opt_state,
+                                  self.loss_scale_state, self._acc_grads,
+                                  jnp.int32(self.global_steps))
         self._acc_grads = None
         self.global_steps += 1
         self.global_samples += int(self.config.train_batch_size)
         if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
             self.skipped_steps += 1
         self._last_metrics = metrics
+        self._close_step_span()
         self._write_monitor(metrics)
 
     def train_batch(self, data_iter: Optional[Iterator[Batch]] = None
@@ -658,6 +668,7 @@ class DeepSpeedTPUEngine:
             self._check_batch_consistency(micros, local=own_data)
         batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
+        self._step_t0 = telemetry.tracer.now()
         self._rng, sub = jax.random.split(self._rng)
         if self._param_stream is not None or self._zenflow is not None:
             runner = self._param_stream or self._zenflow
@@ -668,6 +679,7 @@ class DeepSpeedTPUEngine:
             if self.curriculum_scheduler is not None:
                 self.curriculum_scheduler.update_difficulty(self.global_steps)
             self.tput_timer.stop(sync=loss)
+            self._close_step_span()
             self._write_monitor(self._last_metrics)
             return loss
         if self.offload_enabled:
@@ -709,6 +721,7 @@ class DeepSpeedTPUEngine:
                 self.curriculum_scheduler.update_difficulty(self.global_steps)
             self._last_metrics = metrics
             self.tput_timer.stop(sync=loss)
+            self._close_step_span()
             self._write_monitor(metrics)
             return loss
         self.params, self.opt_state, self.loss_scale_state, metrics = \
@@ -725,6 +738,7 @@ class DeepSpeedTPUEngine:
         self._last_metrics = metrics
         loss = metrics["loss"]
         self.tput_timer.stop(sync=loss)
+        self._close_step_span()
         self._write_monitor(metrics)
         return loss
 
@@ -986,6 +1000,61 @@ class DeepSpeedTPUEngine:
             seed=self.config.seed,
             data_sampler=sampler)
 
+    # ------------------------------------------------------------ telemetry
+
+    def _init_telemetry(self) -> None:
+        tcfg = self.config.telemetry
+        telemetry.configure(tcfg)   # enable-only; never silences the tracer
+        if tcfg.enabled and tcfg.trace_file:
+            import atexit
+            atexit.register(telemetry.tracer.dump, tcfg.trace_file)
+        self._step_t0: Optional[float] = None
+        self._mem_sampler = telemetry.MemorySampler() \
+            if tcfg.sample_memory else None
+        self._peak_flops = tcfg.peak_flops_override or \
+            telemetry.peak_flops()
+        fpt = getattr(self.model, "flops_per_token", None) or 0.0
+        tps = getattr(self.model, "tokens_per_sample", None) or 0
+        #: total model FLOPs per optimizer step across the whole batch
+        #: (flops_per_token already counts fwd+bwd, the 6N convention)
+        self._flops_per_step = fpt * tps * int(self.config.train_batch_size)
+
+    def _record_step_telemetry(self, dt_s: float) -> None:
+        """Per-step registry metrics (always on — the registry is cheap).
+
+        ``dt_s`` is HOST wall time for the step: under jax async dispatch
+        it measures dispatch + any host work, not device latency, except
+        on steps something synced (ThroughputTimer reporting steps, host
+        optimizer sweeps). The MFU gauge inherits this caveat; the synced
+        per-interval throughput line remains the calibrated number."""
+        reg = telemetry.registry
+        reg.counter("train/steps", help="optimizer steps completed").inc()
+        if dt_s > 0:
+            reg.histogram(
+                "train/step_time_ms", lo=1e-2, hi=1e6,
+                help="host wall time per optimizer step (ms)"
+            ).record(dt_s * 1e3)
+            reg.gauge(
+                "train/mfu",
+                help="model FLOPs utilization vs peak (0 when peak unknown)"
+            ).set(telemetry.mfu(self._flops_per_step, dt_s,
+                                n_devices=jax.device_count(),
+                                peak=self._peak_flops or None))
+        if self._mem_sampler is not None and \
+                self.global_steps % max(1, self.config.steps_per_print) == 0:
+            self._mem_sampler.sample()
+
+    def _close_step_span(self) -> None:
+        """Close the whole-step window opened by the first forward() of the
+        accumulation window (or by train_batch): emit the ``train/step``
+        span and the per-step registry metrics."""
+        t1 = telemetry.tracer.now()
+        t0 = self._step_t0 if self._step_t0 is not None else t1
+        self._step_t0 = None
+        telemetry.tracer.complete("train/step", t0, t1,
+                                  step=self.global_steps)
+        self._record_step_telemetry(t1 - t0)
+
     # -------------------------------------------------------------- monitor
 
     def _build_monitor(self):
@@ -1019,6 +1088,9 @@ class DeepSpeedTPUEngine:
                   for (step, _), vals in zip(pending, fetched)
                   for k, val in vals.items()]
         self.monitor.write_events(events)
+        # registry snapshot rides the same flush cadence (MFU, step-time
+        # histogram aggregates, mem/* watermarks, comm/* counters)
+        telemetry.registry.flush_to_monitor(self.monitor, self.global_steps)
 
     # ------------------------------------------------------------ utilities
 

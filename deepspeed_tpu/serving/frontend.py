@@ -17,6 +17,7 @@ the token budget → per-token stream callbacks → flush + cache insert.
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue
@@ -189,8 +190,10 @@ class ServingFrontend:
         while self._try_admit_one(now):
             progressed = True
         self.metrics.queue_depth.record(float(len(self.queue)))
-        out = self.engine.step_with_budget(budget=self.token_budget,
-                                           mode=self.mode)
+        with telemetry.tracer.span("serving/engine_step",
+                                   batch=len(self._running)):
+            out = self.engine.step_with_budget(budget=self.token_budget,
+                                               mode=self.mode)
         if out is None:
             return progressed or bool(self._running or len(self.queue))
         self.metrics.bump("engine_steps")
@@ -236,12 +239,36 @@ class ServingFrontend:
         req.state = state
         req.finish_reason = reason
         req.finish_ts = now
+        self._trace_lifecycle(req, reason, now)
         if req.tpot is not None:
             self.metrics.tpot.record(req.tpot)
         if state is RequestState.FINISHED:
             self.metrics.bump("completed")
         elif state is RequestState.CANCELLED:
             self.metrics.bump("cancelled")
+
+    def _trace_lifecycle(self, req: Request, reason: str,
+                         now: float) -> None:
+        """Emit the request's phase spans retroactively at terminal state
+        (queued → prefill → decode, plus the whole-request envelope), one
+        trace track per request (tid = uid). The frontend's clock and the
+        tracer's are both CLOCK_MONOTONIC-derived, so the retroactive
+        timestamps land on the tracer's timeline (see Tracer.complete)."""
+        tr = telemetry.tracer
+        if not tr.enabled or req.enqueue_ts is None:
+            return
+        tid = req.uid
+        tr.complete("serving/request", req.enqueue_ts, now, tid=tid,
+                    reason=reason, tokens_out=len(req.tokens_out),
+                    cached_tokens=req.cached_tokens)
+        if req.schedule_ts is not None:
+            tr.complete("serving/request/queued", req.enqueue_ts,
+                        req.schedule_ts, tid=tid)
+            if req.first_token_ts is not None:
+                tr.complete("serving/request/prefill", req.schedule_ts,
+                            req.first_token_ts, tid=tid)
+                tr.complete("serving/request/decode", req.first_token_ts,
+                            now, tid=tid)
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         """Pump until every admitted request reached a terminal state."""
@@ -271,6 +298,12 @@ class ServingFrontend:
         self.metrics.emit(self.monitor, self.cache,
                           step if step is not None
                           else self.metrics.counters["engine_steps"])
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process-wide registry (the
+        ``serving/*`` series plus anything else recorded in-process) —
+        wire this to a ``/metrics`` HTTP handler."""
+        return telemetry.metrics_text()
 
     def stats(self) -> Dict[str, Any]:
         out: Dict[str, Any] = dict(self.metrics.counters)

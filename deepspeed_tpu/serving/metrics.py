@@ -6,64 +6,43 @@ they are kept as fixed-bucket histograms host-side (no device traffic) and
 flushed through :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster` as
 ``serving/*`` events so whatever writer stack training already configured
 (TensorBoard/W&B/Comet/CSV) picks them up unchanged.
+
+The histogram implementation lives in
+:mod:`deepspeed_tpu.telemetry.registry` (one bucketing implementation for
+the repo); each :class:`ServingMetrics` also publishes its histograms into
+the process-wide registry under ``serving/ttft_seconds`` /
+``serving/tpot_seconds`` / ``serving/queue_depth`` and mirrors its
+counters, so ``telemetry.metrics_text()`` exposes them in Prometheus
+format alongside the ``train/*`` series.
 """
 
-import bisect
-import math
 from typing import Dict, List, Optional, Tuple
 
-
-class Histogram:
-    """Fixed log-spaced buckets; O(log B) record, exact count/sum."""
-
-    def __init__(self, lo: float = 1e-4, hi: float = 100.0,
-                 n_buckets: int = 40):
-        ratio = (hi / lo) ** (1.0 / (n_buckets - 1))
-        self.bounds = [lo * ratio ** i for i in range(n_buckets)]
-        self.counts = [0] * (n_buckets + 1)
-        self.count = 0
-        self.total = 0.0
-        self.vmin: Optional[float] = None
-        self.vmax: Optional[float] = None
-
-    def record(self, v: float) -> None:
-        if not math.isfinite(v):
-            return
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
-        self.count += 1
-        self.total += v
-        self.vmin = v if self.vmin is None else min(self.vmin, v)
-        self.vmax = v if self.vmax is None else max(self.vmax, v)
-
-    @property
-    def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
-
-    def percentile(self, p: float) -> float:
-        """Upper bound of the bucket holding the p-th percentile sample."""
-        if not self.count:
-            return 0.0
-        target = p / 100.0 * self.count
-        acc = 0
-        for i, c in enumerate(self.counts):
-            acc += c
-            if acc >= target:
-                return self.bounds[min(i, len(self.bounds) - 1)]
-        return self.bounds[-1]
-
-    def summary(self) -> Dict[str, float]:
-        return {"count": self.count, "mean": self.mean,
-                "p50": self.percentile(50), "p99": self.percentile(99),
-                "min": self.vmin or 0.0, "max": self.vmax or 0.0}
+# Histogram moved to the unified registry; re-exported here so existing
+# `from deepspeed_tpu.serving.metrics import Histogram` imports keep working
+from deepspeed_tpu.telemetry.registry import Histogram  # noqa: F401
+from deepspeed_tpu.telemetry.registry import registry as _registry
 
 
 class ServingMetrics:
-    """Aggregates the frontend's counters + histograms and emits them."""
+    """Aggregates the frontend's counters + histograms and emits them.
+
+    Instance-local (one per frontend, tests assert exact counts) but
+    registered process-wide with ``replace=True`` so the registry always
+    exposes the most recently constructed frontend's histograms.
+    """
 
     def __init__(self):
         self.ttft = Histogram()
         self.tpot = Histogram(lo=1e-5, hi=10.0)
         self.queue_depth = Histogram(lo=1.0, hi=4096.0, n_buckets=13)
+        _registry.register("serving/ttft_seconds", self.ttft,
+                           help="time to first token (s)", replace=True)
+        _registry.register("serving/tpot_seconds", self.tpot,
+                           help="time per output token (s)", replace=True)
+        _registry.register("serving/queue_depth", self.queue_depth,
+                           help="admission queue depth at step start",
+                           replace=True)
         self.counters: Dict[str, int] = {
             "admitted": 0, "completed": 0, "cancelled": 0, "shed": 0,
             "rejected_queue_full": 0, "rejected_kv_exhausted": 0,
@@ -73,6 +52,8 @@ class ServingMetrics:
 
     def bump(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
+        if by > 0:   # registry counters are process-wide and monotonic
+            _registry.counter(f"serving/{name}").inc(by)
 
     def events(self, cache=None, step: int = 0
                ) -> List[Tuple[str, float, int]]:

@@ -1,0 +1,54 @@
+"""deepspeed_tpu.telemetry — unified tracing, metrics, and MFU/memory
+accounting across the engine, comm layer, and serving frontend.
+
+The reference threads observability through five disconnected pieces
+(MonitorMaster events, SynchronizedWallClockTimer, comms logging, the
+flops profiler, serving histograms); this package gives them one spine:
+
+- :mod:`~deepspeed_tpu.telemetry.tracer` — nestable spans → Chrome/
+  Perfetto trace-event JSON (+ optional jax.profiler annotations);
+- :mod:`~deepspeed_tpu.telemetry.registry` — process-wide Counters/
+  Gauges/Histograms with Prometheus text exposition and a MonitorMaster
+  bridge;
+- :mod:`~deepspeed_tpu.telemetry.sampler` — device-memory watermarks and
+  MFU against the per-platform peak-FLOPs table;
+- :mod:`~deepspeed_tpu.telemetry.summarize` — the trace self-time CLI
+  (``python -m deepspeed_tpu.telemetry.summarize`` / ``bin/dstpu-trace``).
+
+See docs/observability.md for the config reference, the trace-capture
+workflow, and the metric-name catalog.
+"""
+
+from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
+                                              Histogram, MetricsRegistry,
+                                              registry)
+from deepspeed_tpu.telemetry.sampler import (MemorySampler,  # noqa: F401
+                                             device_memory_stats,
+                                             host_rss_bytes, mfu,
+                                             peak_flops)
+from deepspeed_tpu.telemetry.tracer import Tracer, tracer  # noqa: F401
+
+__all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
+           "Gauge", "Histogram", "MemorySampler", "peak_flops", "mfu",
+           "device_memory_stats", "host_rss_bytes", "configure",
+           "metrics_text"]
+
+
+def configure(telemetry_config) -> None:
+    """Apply a :class:`~deepspeed_tpu.config.config.TelemetryConfig` to
+    the process-wide tracer. Enable-only: an engine whose config leaves
+    telemetry off must not silence a tracer something else (bench
+    ``--trace``, a test) already turned on."""
+    if telemetry_config is None or \
+            not getattr(telemetry_config, "enabled", False):
+        return
+    tracer.configure(
+        enabled=True,
+        buffer_events=getattr(telemetry_config, "trace_buffer_events", None),
+        jax_annotations=getattr(telemetry_config, "jax_annotations", None))
+
+
+def metrics_text() -> str:
+    """Prometheus text exposition of the process-wide registry — the
+    payload for a ``/metrics`` endpoint."""
+    return registry.prometheus_text()

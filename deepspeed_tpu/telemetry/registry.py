@@ -1,0 +1,277 @@
+"""Unified metrics registry: process-wide Counters / Gauges / Histograms.
+
+One namespace for every subsystem's numbers — engine step time and MFU,
+collective byte counts, serving latencies — instead of five private
+counter dicts. Two egress paths:
+
+- :meth:`MetricsRegistry.prometheus_text` renders the standard Prometheus
+  text exposition format (serve it from any HTTP handler, or snapshot it
+  in tests);
+- :meth:`MetricsRegistry.flush_to_monitor` bridges a snapshot through the
+  existing :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster` writers,
+  so TensorBoard/W&B/Comet/CSV keep working with zero extra config.
+
+The :class:`Histogram` here is THE bucketing implementation for the repo
+(``serving/metrics.py`` imports it back under its old name).
+"""
+
+import bisect
+import math
+import re
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple, Union
+
+Event = Tuple[str, float, int]
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """Metric name → valid Prometheus name (``train/step_time_ms`` →
+    ``train_step_time_ms``)."""
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return f"{float(v):.10g}"
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, by: Union[int, float] = 1) -> None:
+        if by < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self.value += by
+
+
+class Gauge:
+    """Last-written value."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+
+class Histogram:
+    """Fixed log-spaced buckets; O(log B) record, exact count/sum.
+
+    ``bounds[i]`` is bucket i's inclusive upper edge; ``counts`` has one
+    extra overflow slot so values ``> hi`` are never misfiled into the top
+    regular bucket (``bounds[-1]`` is pinned to exactly ``hi`` — the
+    geometric ladder's float rounding used to leave it a hair above or
+    below, sending boundary values to the wrong side). ``vmin``/``vmax``
+    track exact extremes regardless of bucketing.
+    """
+
+    def __init__(self, lo: float = 1e-4, hi: float = 100.0,
+                 n_buckets: int = 40):
+        if n_buckets < 2:
+            raise ValueError("Histogram needs n_buckets >= 2")
+        if not (0 < lo < hi):
+            raise ValueError(f"Histogram needs 0 < lo < hi, got {lo}, {hi}")
+        ratio = (hi / lo) ** (1.0 / (n_buckets - 1))
+        self.bounds = [lo * ratio ** i for i in range(n_buckets)]
+        self.bounds[-1] = float(hi)
+        self.counts = [0] * (n_buckets + 1)
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def record(self, v: float) -> None:
+        if not math.isfinite(v):
+            return
+        with self._lock:
+            self.counts[bisect.bisect_left(self.bounds, v)] += 1
+            self.count += 1
+            self.total += v
+            self.vmin = v if self.vmin is None else min(self.vmin, v)
+            self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket holding the p-th percentile sample
+        (the exact ``vmax`` for samples in the overflow bucket)."""
+        if not self.count:
+            return 0.0
+        target = p / 100.0 * self.count
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                if i >= len(self.bounds):
+                    return self.vmax if self.vmax is not None \
+                        else self.bounds[-1]
+                return self.bounds[i]
+        return self.vmax if self.vmax is not None else self.bounds[-1]
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean,
+                "p50": self.percentile(50), "p99": self.percentile(99),
+                "min": self.vmin or 0.0, "max": self.vmax or 0.0}
+
+
+Metric = Union[Counter, Gauge, Histogram]
+
+
+class MetricsRegistry:
+    """Named metric store with get-or-create accessors.
+
+    Names use ``/`` namespacing (``train/mfu``, ``serving/ttft_seconds``);
+    the Prometheus renderer sanitizes them. Histograms owned by per-object
+    aggregators (e.g. one :class:`ServingMetrics` per frontend) register
+    with ``replace=True`` so the registry always exposes the live one.
+    """
+
+    def __init__(self):
+        self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        self._help: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, name: str, metric: Metric, help: str = "",
+                 replace: bool = False) -> Metric:
+        with self._lock:
+            if name in self._metrics and not replace:
+                raise ValueError(f"metric {name!r} already registered")
+            self._metrics[name] = metric
+            if help or name not in self._help:
+                self._help[name] = help
+        return metric
+
+    def _get_or_create(self, name: str, cls, help: str, **kw) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name!r} is {type(m).__name__}, "
+                        f"requested {cls.__name__}")
+                return m
+            m = cls(name, **kw) if cls is not Histogram else Histogram(**kw)
+            self._metrics[name] = m
+            if help or name not in self._help:
+                self._help[name] = help
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help)
+
+    def histogram(self, name: str, lo: float = 1e-4, hi: float = 100.0,
+                  n_buckets: int = 40, help: str = "") -> Histogram:
+        return self._get_or_create(name, Histogram, help,
+                                   lo=lo, hi=hi, n_buckets=n_buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+            self._help.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._help.clear()
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._metrics)
+
+    # -- exposition ---------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (v0.0.4) of every registered metric.
+        Histogram buckets are rendered cumulatively with an explicit
+        ``+Inf`` bucket, per the format spec."""
+        with self._lock:
+            items = list(self._metrics.items())
+            helps = dict(self._help)
+        lines: List[str] = []
+        for name, m in items:
+            pn = prom_name(name)
+            if helps.get(name):
+                lines.append(f"# HELP {pn} {helps[name]}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pn} counter")
+                lines.append(f"{pn} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pn} gauge")
+                lines.append(f"{pn} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {pn} histogram")
+                acc = 0
+                for bound, c in zip(m.bounds, m.counts):
+                    acc += c
+                    lines.append(
+                        f'{pn}_bucket{{le="{_fmt(bound)}"}} {acc}')
+                acc += m.counts[-1]
+                lines.append(f'{pn}_bucket{{le="+Inf"}} {acc}')
+                lines.append(f"{pn}_sum {_fmt(m.total)}")
+                lines.append(f"{pn}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # -- monitor bridge -----------------------------------------------------
+
+    def events(self, step: int = 0) -> List[Event]:
+        """Snapshot as ``(name, value, step)`` monitor events. Histograms
+        contribute mean/p99/count derived series (a TB scalar can't carry
+        buckets)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        ev: List[Event] = []
+        for name, m in items:
+            if isinstance(m, (Counter, Gauge)):
+                ev.append((name, float(m.value), step))
+            elif isinstance(m, Histogram) and m.count:
+                ev.append((f"{name}_mean", m.mean, step))
+                ev.append((f"{name}_p99", m.percentile(99), step))
+                ev.append((f"{name}_count", float(m.count), step))
+        return ev
+
+    def flush_to_monitor(self, monitor, step: int = 0) -> None:
+        """Write a snapshot through a MonitorMaster (no-op when monitoring
+        is disabled or absent)."""
+        if monitor is None or not getattr(monitor, "enabled", False):
+            return
+        ev = self.events(step)
+        if ev:
+            monitor.write_events(ev)
+
+
+#: process-wide registry (counterpart of the process-wide ``tracer``)
+registry = MetricsRegistry()

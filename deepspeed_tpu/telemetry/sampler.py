@@ -1,0 +1,131 @@
+"""Resource samplers: device-memory watermarks and MFU accounting.
+
+Memory: jax device ``memory_stats()`` where the backend reports it (TPU,
+GPU), falling back to summing live device buffers, falling back to
+nothing — plus host RSS from /proc (psutil when available). Every path
+degrades to a clean no-op; sampling must never take a training loop down.
+
+MFU: achieved FLOPs/s/chip over peak, with the bf16 peak-FLOPs table
+keyed by TPU platform generation (public chip specs — the same numbers
+``bench.py`` has always used; this module is now their home).
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.registry import registry as _global_registry
+
+#: bf16 peak FLOPs/s per chip by device kind substring (public TPU specs)
+PEAK_FLOPS_BF16: Dict[str, float] = {
+    "v6e": 918e12, "trillium": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def peak_flops(device: Any = None) -> float:
+    """Peak bf16 FLOPs/s for ``device`` (default: first jax device).
+    0.0 for CPU/unknown platforms — MFU is not meaningful there."""
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:
+            return 0.0
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    for key, val in PEAK_FLOPS_BF16.items():
+        if key in kind:
+            return val
+    return 0.0
+
+
+def mfu(flops: float, seconds: float, n_devices: int = 1,
+        peak: Optional[float] = None) -> float:
+    """Model FLOPs utilization: ``flops`` (total model FLOPs for the
+    measured interval, all chips) executed in ``seconds`` over
+    ``n_devices`` chips of ``peak`` FLOPs/s each. Returns 0.0 whenever
+    the ratio is undefined (no peak known, zero interval)."""
+    if seconds <= 0.0 or flops <= 0.0:
+        return 0.0
+    peak = peak_flops() if peak is None else peak
+    if not peak:
+        return 0.0
+    return flops / seconds / (max(1, n_devices) * peak)
+
+
+def device_memory_stats(device: Any = None) -> Optional[Dict[str, float]]:
+    """``device.memory_stats()`` as floats, or None when the backend does
+    not implement it (CPU) or jax is unavailable."""
+    try:
+        import jax
+        device = device if device is not None else jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: float(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
+def live_buffer_bytes() -> Optional[float]:
+    """Total bytes of live jax arrays (the ``live_buffers`` fallback when
+    ``memory_stats`` is unavailable). Counts global logical bytes."""
+    try:
+        import jax
+        return float(sum(getattr(x, "nbytes", 0)
+                         for x in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def host_rss_bytes() -> Optional[float]:
+    """Host resident-set size in bytes (psutil, else /proc/self/statm)."""
+    try:
+        import psutil
+        return float(psutil.Process().memory_info().rss)
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        return None
+
+
+class MemorySampler:
+    """Samples device + host memory into ``mem/*`` gauges.
+
+    ``mem/device_bytes_in_use`` — current device allocation (from
+    ``memory_stats`` or the live-buffer sum); ``mem/device_peak_bytes`` —
+    high-watermark (backend-reported peak when available, else the max
+    sample seen); ``mem/host_rss_bytes`` — process RSS. Missing sources
+    are skipped, never raised.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._reg = registry if registry is not None else _global_registry
+        self._peak = 0.0
+
+    def sample(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        stats = device_memory_stats()
+        in_use = stats.get("bytes_in_use") if stats else None
+        if in_use is None:
+            in_use = live_buffer_bytes()
+        if in_use is not None:
+            backend_peak = (stats or {}).get("peak_bytes_in_use", 0.0)
+            self._peak = max(self._peak, backend_peak, in_use)
+            out["mem/device_bytes_in_use"] = in_use
+            out["mem/device_peak_bytes"] = self._peak
+        rss = host_rss_bytes()
+        if rss is not None:
+            out["mem/host_rss_bytes"] = rss
+        for name, val in out.items():
+            self._reg.gauge(name).set(val)
+        return out

@@ -1,0 +1,121 @@
+"""Trace-summary CLI: per-span self-time breakdown of a dumped trace.
+
+``python -m deepspeed_tpu.telemetry.summarize trace.json`` (or the
+``bin/dstpu-trace`` wrapper) loads a Chrome trace-event JSON produced by
+:meth:`deepspeed_tpu.telemetry.tracer.Tracer.dump` (or any tool emitting
+the same format) and prints, per span name: call count, total wall time,
+and SELF time — total minus time spent in nested child spans on the same
+thread. Self time is the number that answers "where did step time go":
+a ``train/step`` span that is 95% covered by its forward/backward/
+optimizer children has ~5% self time (host-side glue).
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Load trace events from ``path`` — accepts both the object form
+    (``{"traceEvents": [...]}``) and a bare event array."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict):
+        events = data.get("traceEvents", [])
+    elif isinstance(data, list):
+        events = data
+    else:
+        raise ValueError(f"{path}: not a Chrome trace (got {type(data)})")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def self_times(events: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per-name aggregation over complete ('X') events:
+    ``{name: {count, total_us, self_us}}``.
+
+    Nesting is reconstructed per (pid, tid) track from ts/dur containment:
+    events are swept in start order (ties: longer span first = parent), a
+    stack tracks open spans, and each span's duration is charged against
+    its innermost enclosing parent's self time.
+    """
+    stats: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+    tracks: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X" and "ts" in e:
+            tracks[(e.get("pid", 0), e.get("tid", 0))].append(e)
+
+    def close(item) -> None:
+        _end, child_us, e = item
+        dur = float(e.get("dur", 0.0))
+        rec = stats[str(e.get("name", "?"))]
+        rec["count"] += 1
+        rec["total_us"] += dur
+        rec["self_us"] += max(0.0, dur - child_us)
+
+    for track in tracks.values():
+        track.sort(key=lambda e: (float(e["ts"]),
+                                  -float(e.get("dur", 0.0))))
+        stack: List[list] = []          # [end_us, child_us_accum, event]
+        for e in track:
+            ts = float(e["ts"])
+            dur = float(e.get("dur", 0.0))
+            while stack and stack[-1][0] <= ts + 1e-9:
+                close(stack.pop())
+            if stack:
+                stack[-1][1] += dur
+            stack.append([ts + dur, 0.0, e])
+        while stack:
+            close(stack.pop())
+    return dict(stats)
+
+
+def format_table(stats: Dict[str, Dict[str, float]], sort: str = "self",
+                 top: int = 0) -> str:
+    """Render the self-time table (sorted by ``self`` | ``total`` |
+    ``count``; ``top`` > 0 truncates)."""
+    if not stats:
+        return "(no complete spans in trace)"
+    key = {"self": lambda kv: -kv[1]["self_us"],
+           "total": lambda kv: -kv[1]["total_us"],
+           "count": lambda kv: -kv[1]["count"]}[sort]
+    rows = sorted(stats.items(), key=key)
+    if top > 0:
+        rows = rows[:top]
+    grand_self = sum(r["self_us"] for r in stats.values()) or 1.0
+    width = max(24, max(len(n) for n, _ in rows) + 2)
+    lines = [f"{'span':<{width}}{'count':>8}{'total ms':>12}"
+             f"{'self ms':>12}{'self %':>8}"]
+    for name, r in rows:
+        lines.append(
+            f"{name:<{width}}{int(r['count']):>8}"
+            f"{r['total_us'] / 1e3:>12.3f}"
+            f"{r['self_us'] / 1e3:>12.3f}"
+            f"{100.0 * r['self_us'] / grand_self:>8.1f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-trace",
+        description="Per-span self-time breakdown of a deepspeed_tpu "
+                    "Chrome trace-event JSON dump")
+    ap.add_argument("trace", help="trace file (tracer.dump output)")
+    ap.add_argument("--sort", choices=("self", "total", "count"),
+                    default="self", help="sort column (default: self)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="show only the top N spans (0 = all)")
+    args = ap.parse_args(argv)
+    events = load_trace(args.trace)
+    print(format_table(self_times(events), sort=args.sort, top=args.top))
+    n_instant = sum(1 for e in events if e.get("ph") == "i")
+    if n_instant:
+        print(f"\n({n_instant} instant events not shown — e.g. comm/* "
+              f"trace-time markers)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,18 +36,29 @@ class _Timer:
     def stop(self, record: bool = True) -> None:
         if not self.started:
             raise RuntimeError(f"timer {self.name} not started")
-        delta = time.perf_counter() - self._start
+        end = time.perf_counter()
+        delta = end - self._start
         self._elapsed += delta
         if record:
             self.records.append(delta)
         self.started = False
+        # mirror every stop into the trace (no-op while tracing is off)
+        from deepspeed_tpu.telemetry import tracer
+        tracer.complete(f"timer/{self.name}", self._start, end)
 
     def reset(self) -> None:
+        """Clear ALL accumulated state — elapsed, records, and any
+        in-flight start (a reset mid-window must not leave a stale
+        ``started`` that makes the next ``start()`` raise)."""
         self.started = False
+        self._start = 0.0
         self._elapsed = 0.0
+        self.records.clear()
 
     def elapsed(self, reset: bool = True) -> float:
-        """Elapsed time in seconds since last reset."""
+        """Elapsed time in seconds since last reset (0.0 when the timer
+        never ran). A running timer is sampled without losing the window:
+        stop(record=False) + immediate restart."""
         if self.started:
             self.stop(record=False)
             self.start()
@@ -57,6 +68,7 @@ class _Timer:
         return value
 
     def mean(self) -> float:
+        """Mean of recorded stop() intervals; 0.0 with no records."""
         return sum(self.records) / len(self.records) if self.records else 0.0
 
 

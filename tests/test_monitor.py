@@ -71,6 +71,40 @@ def test_unavailable_backends_degrade_to_noop(monkeypatch):
     assert not tb.enabled
 
 
+def test_csv_rows_survive_hard_exit(tmp_path):
+    """Regression: the CSV writer must flush every write_events batch so
+    rows survive a process that dies WITHOUT a clean close (os._exit
+    skips atexit, buffered-file finalizers, everything)."""
+    import subprocess
+    import sys
+    script = f"""
+import os
+from deepspeed_tpu.config.config import CSVConfig
+from deepspeed_tpu.monitor.monitor import CSVMonitor
+w = CSVMonitor(CSVConfig(enabled=True, output_path={str(tmp_path)!r},
+                         job_name="hardexit"))
+w.write_events([("Train/loss", 2.5, 1), ("Train/loss", 2.0, 2)])
+os._exit(0)   # no close(), no interpreter shutdown
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    fname = os.path.join(str(tmp_path), "hardexit", "Train_loss.csv")
+    with open(fname, newline="") as fh:
+        rows = list(csv.reader(fh))
+    assert rows == [["step", "Train/loss"], ["1", "2.5"], ["2", "2.0"]]
+
+
+def test_csv_monitor_creates_parent_dirs(tmp_path):
+    """output_path several levels deep must be created, not errored on."""
+    deep = os.path.join(str(tmp_path), "a", "b", "c")
+    mc = _monitor_cfg(csv_monitor={"enabled": True, "output_path": deep,
+                                   "job_name": "nested"})
+    master = MonitorMaster(mc)
+    master.write_events([("m", 1.0, 0)])
+    assert os.path.exists(os.path.join(deep, "nested", "m.csv"))
+
+
 def test_engine_writes_monitor_events(devices, tmp_path):
     """End-to-end: engine train steps emit Train/* rows via the CSV
     writer (reference engine.py:2822 _write_monitor)."""
