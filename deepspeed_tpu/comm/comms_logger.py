@@ -16,18 +16,44 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 def convert_size(size_bytes: int) -> str:
-    """Reference: utils/comms_logging.py:convert_size."""
+    """Reference: utils/comms_logging.py:convert_size — hardened: a
+    negative size (buggy caller, or a delta computed across a reset)
+    used to crash in math.log; render it signed instead of taking the
+    whole summary table down."""
     if size_bytes == 0:
         return "0B"
+    if size_bytes < 0:
+        return f"-{convert_size(-size_bytes)}"
     names = ("B", "KB", "MB", "GB", "TB", "PB")
-    i = int(math.floor(math.log(size_bytes, 1024)))
+    i = min(int(math.floor(math.log(size_bytes, 1024))), len(names) - 1)
     p = math.pow(1024, i)
     return f"{round(size_bytes / p, 2)} {names[i]}"
 
 
+#: ops whose algorithmic bandwidth factor is known (reference get_bw)
+_KNOWN_MSG_OPS = frozenset((
+    "all_reduce", "psum", "all_gather", "reduce_scatter", "all_to_all",
+    "broadcast", "send", "recv", "barrier", "ppermute", "pmean"))
+#: unrecognized op names seen so far (warned once, listing all of them)
+_unknown_msg_ops: set = set()
+
+
 def get_msg_size(op_name: str, size_bytes: int, world: int) -> int:
     """Algorithmic message size per rank for bandwidth accounting
-    (reference utils/comms_logging.py:get_bw factor logic)."""
+    (reference utils/comms_logging.py:get_bw factor logic). An
+    unrecognized op falls back to ``size_bytes`` (factor 1) — correct for
+    point-to-point, an over-estimate for unknown collectives — and warns
+    ONCE naming every unknown op seen so far, so a typo'd op name can't
+    silently skew the doctor's bandwidth table forever."""
+    if size_bytes < 0:
+        raise ValueError(f"get_msg_size: negative size_bytes "
+                         f"({size_bytes}) for op {op_name!r}")
+    if op_name not in _KNOWN_MSG_OPS and op_name not in _unknown_msg_ops:
+        _unknown_msg_ops.add(op_name)
+        logger.warning(
+            f"get_msg_size: unrecognized op {op_name!r} — using factor 1 "
+            f"(raw bytes) for bandwidth accounting. Unknown ops so far: "
+            f"{sorted(_unknown_msg_ops)}")
     if world <= 1:
         return size_bytes
     if op_name in ("all_reduce", "psum"):

@@ -339,10 +339,30 @@ class FlopsProfilerConfig(TPUConfigModel):
     output_file: Optional[str] = None
 
 
+class WatchdogConfig(TPUConfigModel):
+    """``"telemetry": {"watchdog": {...}}`` → telemetry/watchdog.py. The
+    engine arms the watchdog around each train_batch / serving decode
+    step; a missed deadline dumps all-thread stacks + the flight-recorder
+    black box, then warns or kills per ``action``."""
+    enabled: bool = False
+    #: a step taking longer than this (compile excluded only by making it
+    #: generous) trips the watchdog
+    step_timeout_s: float = Field(default=300.0, gt=0)
+    #: "warn": log + dump and keep going; "kill": dump then hard-exit 124
+    #: so the launcher's restart policy takes over
+    action: Literal["warn", "kill"] = "warn"
+    #: where stack/black-box/metric dumps land (default: cwd)
+    dump_dir: Optional[str] = None
+    #: per-host heartbeat JSON for dstpu-doctor straggler naming (default:
+    #: env DSTPU_HEARTBEAT_FILE, exported by launcher/agent.py)
+    heartbeat_file: Optional[str] = None
+
+
 class TelemetryConfig(TPUConfigModel):
     """``"telemetry"`` block → deepspeed_tpu/telemetry (tracer + registry +
-    samplers). Metrics recording is always on (cheap, process-wide
-    registry); this block controls span *tracing* and its export."""
+    samplers + diagnostics). Metrics recording and the flight recorder are
+    always on (cheap, process-wide); this block controls span *tracing*,
+    its export, and the diagnostics layer's knobs."""
     enabled: bool = False
     #: ring-buffer capacity; oldest spans evicted beyond this
     trace_buffer_events: int = Field(default=100_000, ge=1)
@@ -356,6 +376,14 @@ class TelemetryConfig(TPUConfigModel):
     #: override the per-chip peak FLOPs/s used for MFU (0/None → auto
     #: from the device kind; CPU has no peak, so MFU reads 0 there)
     peak_flops_override: Optional[float] = Field(default=None, gt=0)
+    #: flight-recorder ring size (per-step records kept for the black box)
+    flight_recorder_steps: int = Field(default=512, ge=1)
+    #: where crash/preemption black boxes land (default:
+    #: ``dstpu_blackbox_<pid>.json`` in the cwd)
+    blackbox_path: Optional[str] = None
+    #: warn once a single function has been retraced this many times
+    compile_storm_threshold: int = Field(default=8, ge=1)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
 
 
 class TensorBoardConfig(TPUConfigModel):
@@ -509,8 +537,12 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     dump_state: bool = False
     memory_breakdown: bool = False
     seed: int = 1234
-    #: jax debug_nans analogue of the reference's NaN/Inf sanity checks
-    check_nan_inf: bool = False
+    #: NaN/Inf sanity checks (reference is_sanity_checks_enabled). True or
+    #: "debug" flips global jax_debug_nans (raises at the offending op but
+    #: de-optimizes EVERY jitted fn); "scoped" keeps full-speed jit and
+    #: instead runs a per-leaf finite check on the grads each step,
+    #: reporting the first bad leaf path through telemetry/anomaly.py
+    check_nan_inf: Union[bool, Literal["debug", "scoped"]] = False
 
     deprecated_aliases = {
         "tensorboard": "monitor_config",

@@ -10,6 +10,7 @@ from `latest` — plus an in-process restart loop for transient failures
 relaunch itself is the launcher's job, launcher/runner.py).
 """
 
+import os
 import signal
 import sys
 from typing import Any, Callable, Dict, Optional
@@ -18,10 +19,13 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class Preempted(SystemExit):
-    """Raised at a step boundary after SIGTERM; carries the saved tag."""
+    """Raised at a step boundary after SIGTERM; carries the saved tag and
+    the flight-recorder black-box path (when one was written)."""
 
-    def __init__(self, tag: Optional[str]):
+    def __init__(self, tag: Optional[str],
+                 blackbox_path: Optional[str] = None):
         self.tag = tag
+        self.blackbox_path = blackbox_path
         super().__init__(143)
 
 
@@ -75,8 +79,25 @@ class DSElasticAgent:
             return
         tag = f"preempt_step{self.engine.global_steps}"
         self.engine.save_checkpoint(self.save_dir, tag=tag)
-        log_dist(f"elastic agent: checkpoint '{tag}' committed, exiting")
-        raise Preempted(tag)
+        # dump the flight recorder next to the checkpoint: the relaunch
+        # operator gets BOTH artifacts (what to resume from + what the
+        # last steps looked like) from this one exit line
+        blackbox = None
+        try:
+            from deepspeed_tpu.telemetry import flight_recorder
+            flight_recorder.record_event(
+                "preemption", checkpoint_tag=tag,
+                step=self.engine.global_steps)
+            blackbox = flight_recorder.dump(
+                os.path.join(self.save_dir, f"blackbox_{tag}.json"),
+                reason="preemption")
+        except Exception as e:
+            logger.warning(f"elastic agent: flight-recorder dump failed: "
+                           f"{e}")
+        log_dist(f"elastic agent: checkpoint '{tag}' committed, "
+                 f"flight-recorder dump "
+                 f"{blackbox or 'unavailable'}, exiting")
+        raise Preempted(tag, blackbox_path=blackbox)
 
     def resume(self) -> Optional[str]:
         """Load the newest checkpoint if one exists (relaunch path)."""

@@ -351,6 +351,12 @@ class RaggedInferenceEngineTPU:
         key = (nb, cb, mode, fresh)
         if key in self._step_fns:
             return self._step_fns[key]
+        # jit-cache miss = one XLA compile; attribute it to the bucket
+        # shape so a recompile storm names the drifting request shape
+        from deepspeed_tpu.telemetry import compile_monitor
+        compile_monitor.count_trace(
+            "serving/step_fn", detail={"n_bucket": nb, "chunk": cb,
+                                       "mode": str(mode), "fresh": fresh})
         mb = self.mb
         model = self.model_config
 
@@ -588,6 +594,10 @@ class RaggedInferenceEngineTPU:
             return self._fused_fns[key]
         if os.environ.get("DSTPU_FUSED_V1"):
             return self._fused_decode_fn_v1(nb, sb, mode)
+        from deepspeed_tpu.telemetry import compile_monitor
+        compile_monitor.count_trace(
+            "serving/fused_decode_fn",
+            detail={"n_bucket": nb, "steps": sb, "mode": str(mode)})
         model = self.model_config
         from deepspeed_tpu.ops.paged_attention import _masked_attention
 
@@ -722,6 +732,10 @@ class RaggedInferenceEngineTPU:
         key = (nb, sb, mode, "v1")
         if key in self._fused_fns:
             return self._fused_fns[key]
+        from deepspeed_tpu.telemetry import compile_monitor
+        compile_monitor.count_trace(
+            "serving/fused_decode_fn_v1",
+            detail={"n_bucket": nb, "steps": sb, "mode": str(mode)})
         model = self.model_config
 
         def fn(params, arena, tokens0, starts0, live, pt, limit, temp,

@@ -14,11 +14,18 @@ setup, supervision, bounded restarts, and signal forwarding:
 - forwards SIGTERM (pod preemption) to the child so the in-process
   DSElasticAgent (elasticity/elastic_agent.py) can checkpoint;
 - restarts the child up to ``max_restarts`` on nonzero exit (the
-  torchelastic worker-group restart), backing off between attempts.
+  torchelastic worker-group restart), backing off between attempts;
+- exports ``DSTPU_HEARTBEAT_FILE`` so the worker's watchdog
+  (telemetry/watchdog.py) stamps per-step heartbeats this host's
+  operator — and ``dstpu-doctor`` — can read to name a straggler, and
+  stamps agent-level status (started/exited/restarting) into the same
+  file while no worker is alive.
 """
 
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -31,13 +38,38 @@ class LaunchAgent:
     """Supervise one per-host worker process (reference launch.py main)."""
 
     def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None,
-                 max_restarts: int = 0, restart_backoff_s: float = 5.0):
+                 max_restarts: int = 0, restart_backoff_s: float = 5.0,
+                 heartbeat_file: Optional[str] = None):
         self.cmd = cmd
         self.env = {**os.environ, **(env or {})}
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
+        self.heartbeat_file = heartbeat_file or \
+            self.env.get("DSTPU_HEARTBEAT_FILE")
+        if self.heartbeat_file:
+            # the worker's watchdog picks this up and takes over stamping
+            self.env["DSTPU_HEARTBEAT_FILE"] = self.heartbeat_file
         self._child: Optional[subprocess.Popen] = None
         self._terminating = False
+
+    def _beat(self, phase: str, **extra) -> None:
+        """Agent-level heartbeat (atomic write, best effort). The worker's
+        watchdog overwrites the same file with per-step beats once it is
+        up; agent beats cover the gaps (spawn, restart backoff, exit)."""
+        if not self.heartbeat_file:
+            return
+        try:
+            doc = {"hostname": socket.gethostname(), "pid": os.getpid(),
+                   "agent": True, "phase": phase, "ts": time.time(),
+                   **extra}
+            parent = os.path.dirname(os.path.abspath(self.heartbeat_file))
+            os.makedirs(parent, exist_ok=True)
+            tmp = f"{self.heartbeat_file}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.heartbeat_file)
+        except Exception:
+            pass
 
     def _forward(self, signum, _frame) -> None:
         """SIGTERM/SIGINT → forward to the child's process group so the
@@ -64,7 +96,10 @@ class LaunchAgent:
                          f"{' '.join(self.cmd)}")
                 self._child = subprocess.Popen(
                     self.cmd, env=self.env, start_new_session=True)
+                self._beat("worker_started", worker_pid=self._child.pid,
+                           attempt=attempt)
                 rc = self._child.wait()
+                self._beat("worker_exited", rc=rc, attempt=attempt)
                 if rc == 0 or self._terminating:
                     return rc
                 if attempt >= self.max_restarts:
@@ -98,16 +133,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-restarts", type=int,
                     default=int(os.environ.get("DSTPU_MAX_RESTARTS", 0)))
+    ap.add_argument("--heartbeat-file", default=None,
+                    help="per-host heartbeat JSON for dstpu-doctor "
+                         "straggler naming (default: env "
+                         "DSTPU_HEARTBEAT_FILE)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = args.cmd
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     if not cmd:
-        print("usage: agent.py [--max-restarts N] -- prog args...",
-              file=sys.stderr)
+        print("usage: agent.py [--max-restarts N] [--heartbeat-file F] "
+              "-- prog args...", file=sys.stderr)
         return 2
-    return LaunchAgent(cmd, max_restarts=args.max_restarts).run()
+    return LaunchAgent(cmd, max_restarts=args.max_restarts,
+                       heartbeat_file=args.heartbeat_file).run()
 
 
 if __name__ == "__main__":

@@ -138,11 +138,19 @@ class DeepSpeedTPUEngine:
         self.global_samples = 0
 
         # sanity checks (reference engine.py:1123 is_sanity_checks_enabled:
-        # NaN/Inf guards + cross-rank dataloader consistency :520). The
-        # jax analogue: debug_nans raises at the op that produced the NaN.
-        if config.check_nan_inf:
+        # NaN/Inf guards + cross-rank dataloader consistency :520). Two
+        # modes: True/"debug" → global jax_debug_nans (raises at the op
+        # that produced the NaN, but de-optimizes every jitted fn);
+        # "scoped" → keep full-speed jit and run loss_scaler.global_check
+        # over the step's pytrees instead, naming the first bad leaf
+        # through telemetry/anomaly.py (costs one scalar sync per step).
+        self._scoped_nan_check = config.check_nan_inf == "scoped"
+        self._scoped_check_jit = None
+        if config.check_nan_inf and not self._scoped_nan_check:
             jax.config.update("jax_debug_nans", True)
             log_dist("sanity checks on: jax_debug_nans enabled")
+        elif self._scoped_nan_check:
+            log_dist("sanity checks on: scoped per-leaf finite check")
 
         # -- optimizer & schedule ------------------------------------------
         self.offload_enabled = (
@@ -598,6 +606,8 @@ class DeepSpeedTPUEngine:
                 "offload_param (layer-streamed schedule); use train_batch()")
         if self._step_t0 is None:           # first micro of the window
             self._step_t0 = telemetry.tracer.now()
+            if self._watchdog is not None:
+                self._watchdog.arm("forward", step=self.global_steps)
         self._rng, sub = jax.random.split(self._rng)
         batch = self._place_batch(batch)
         with telemetry.tracer.span("train/forward", step=self.global_steps):
@@ -669,6 +679,8 @@ class DeepSpeedTPUEngine:
         batch = self._place_stacked_batch(batch, local=own_data)
         self.tput_timer.start()
         self._step_t0 = telemetry.tracer.now()
+        if self._watchdog is not None:
+            self._watchdog.arm("train_batch", step=self.global_steps)
         self._rng, sub = jax.random.split(self._rng)
         if self._param_stream is not None or self._zenflow is not None:
             runner = self._param_stream or self._zenflow
@@ -1018,6 +1030,23 @@ class DeepSpeedTPUEngine:
         #: total model FLOPs per optimizer step across the whole batch
         #: (flops_per_token already counts fwd+bwd, the 6N convention)
         self._flops_per_step = fpt * tps * int(self.config.train_batch_size)
+        # -- diagnostics layer (always-on flight recorder; opt-in watchdog)
+        telemetry.flight_recorder.configure(
+            max_steps=tcfg.flight_recorder_steps, path=tcfg.blackbox_path)
+        telemetry.flight_recorder.set_meta(
+            zero_stage=self.zero_stage, dtype=self.config.compute_dtype,
+            dp_world_size=self.dp_world_size,
+            train_batch_size=int(self.config.train_batch_size))
+        telemetry.flight_recorder.install_excepthook()
+        telemetry.compile_monitor.install(
+            storm_threshold=tcfg.compile_storm_threshold)
+        wcfg = tcfg.watchdog
+        self._watchdog = telemetry.Watchdog(
+            timeout_s=wcfg.step_timeout_s, action=wcfg.action,
+            dump_dir=wcfg.dump_dir,
+            heartbeat_file=wcfg.heartbeat_file or
+            os.environ.get("DSTPU_HEARTBEAT_FILE") or None) \
+            if wcfg.enabled else None
 
     def _record_step_telemetry(self, dt_s: float) -> None:
         """Per-step registry metrics (always on — the registry is cheap).
@@ -1040,9 +1069,41 @@ class DeepSpeedTPUEngine:
             ).set(telemetry.mfu(self._flops_per_step, dt_s,
                                 n_devices=jax.device_count(),
                                 peak=self._peak_flops or None))
+            # step-time regression detection (host wall time, already a
+            # float — no sync); loss/grad anomalies ride the batched
+            # monitor flush instead (see _flush_monitor)
+            telemetry.anomaly_detector.observe(self.global_steps,
+                                               step_time_ms=dt_s * 1e3)
         if self._mem_sampler is not None and \
                 self.global_steps % max(1, self.config.steps_per_print) == 0:
             self._mem_sampler.sample()
+        # flight recorder: one dict append; loss/grad_norm/loss_scale stay
+        # DEVICE scalars until a dump resolves them (no pipeline stall)
+        m = getattr(self, "_last_metrics", None) or {}
+        telemetry.flight_recorder.record_step(
+            self.global_steps, kind="train", dur_s=dt_s,
+            loss=m.get("loss"), grad_norm=m.get("grad_norm"),
+            loss_scale=m.get("loss_scale") if self.fp16_enabled else None,
+            skipped_steps=self.skipped_steps or None)
+
+    def _scoped_finite_check(self) -> None:
+        """``check_nan_inf="scoped"``: per-leaf finite check over the
+        just-updated params — a non-finite grad propagates through the
+        optimizer update, and fp16 overflow-skipped steps keep the old
+        (finite) params, so this never false-positives on a handled
+        overflow. Costs the mode's one documented scalar sync per step;
+        a hit names the first bad leaf through telemetry/anomaly.py."""
+        if not self._scoped_nan_check or self._param_stream is not None \
+                or self.params is None:
+            return
+        from deepspeed_tpu.runtime.loss_scaler import global_check
+        if self._scoped_check_jit is None:
+            self._scoped_check_jit = jax.jit(global_check)
+        bad, flags = self._scoped_check_jit(self.params)
+        if bool(jax.device_get(bad)):
+            path = telemetry.first_flagged_path(jax.device_get(flags))
+            telemetry.anomaly_detector.report_nonfinite(
+                self.global_steps, path, what="params")
 
     def _close_step_span(self) -> None:
         """Close the whole-step window opened by the first forward() of the
@@ -1051,9 +1112,12 @@ class DeepSpeedTPUEngine:
         t1 = telemetry.tracer.now()
         t0 = self._step_t0 if self._step_t0 is not None else t1
         self._step_t0 = None
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         telemetry.tracer.complete("train/step", t0, t1,
                                   step=self.global_steps)
         self._record_step_telemetry(t1 - t0)
+        self._scoped_finite_check()
 
     # -------------------------------------------------------------- monitor
 
@@ -1088,6 +1152,13 @@ class DeepSpeedTPUEngine:
                   for (step, _), vals in zip(pending, fetched)
                   for k, val in vals.items()]
         self.monitor.write_events(events)
+        # anomaly detection over the just-fetched host floats — same
+        # batched cadence, so it never adds a device sync of its own
+        for (step, _), vals in zip(pending, fetched):
+            telemetry.anomaly_detector.observe(
+                step,
+                loss=vals.get("loss"),
+                grad_norm=vals.get("grad_norm"))
         # registry snapshot rides the same flush cadence (MFU, step-time
         # histogram aggregates, mem/* watermarks, comm/* counters)
         telemetry.registry.flush_to_monitor(self.monitor, self.global_steps)

@@ -38,6 +38,23 @@ def check_overflow(grads) -> jax.Array:
     return out
 
 
+def global_check(tree) -> Tuple[jax.Array, Dict]:
+    """Per-leaf finite check: returns (any_nonfinite, flags) where
+    ``flags`` mirrors ``tree``'s structure with one bool scalar per leaf.
+    Unlike :func:`check_overflow` this names WHICH leaf went bad — the
+    engine's ``check_nan_inf="scoped"`` mode feeds the flags to
+    ``telemetry.anomaly.first_flagged_path`` so the blowup report reads
+    "first non-finite leaf: ['decoder']['layers_7']['mlp']['wi']" instead
+    of a bare boolean. Jittable; both outputs are tiny (bool scalars)."""
+    flags = jax.tree.map(
+        lambda g: jnp.logical_not(jnp.isfinite(g).all()), tree)
+    leaves = jax.tree.leaves(flags)
+    out = leaves[0]
+    for f in leaves[1:]:
+        out = jnp.logical_or(out, f)
+    return out, flags
+
+
 def update_scale(state: LossScaleState, overflow: jax.Array,
                  dynamic: bool = True,
                  scale_factor: float = 2.0,

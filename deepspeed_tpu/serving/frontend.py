@@ -86,8 +86,13 @@ class ServingFrontend:
                  cache_pages: Optional[int] = None,
                  monitor=None, mode=("argmax",),
                  token_budget: Optional[int] = None,
-                 emit_every: int = 0, clock=time.monotonic):
+                 emit_every: int = 0, clock=time.monotonic,
+                 watchdog=None):
         self.engine = engine
+        #: optional telemetry.Watchdog armed around each engine step — a
+        #: hung decode (deadlocked collective, runaway compile) dumps
+        #: stacks + the flight recorder instead of silently stalling SLOs
+        self.watchdog = watchdog
         self.policy = TokenBudgetPolicy()
         engine.scheduler.policy = self.policy
         self.queue = AdmissionQueue(max_queue)
@@ -190,13 +195,24 @@ class ServingFrontend:
         while self._try_admit_one(now):
             progressed = True
         self.metrics.queue_depth.record(float(len(self.queue)))
-        with telemetry.tracer.span("serving/engine_step",
-                                   batch=len(self._running)):
-            out = self.engine.step_with_budget(budget=self.token_budget,
-                                               mode=self.mode)
+        if self.watchdog is not None:
+            self.watchdog.arm("serving_step")
+        t0 = time.monotonic()
+        try:
+            with telemetry.tracer.span("serving/engine_step",
+                                       batch=len(self._running)):
+                out = self.engine.step_with_budget(budget=self.token_budget,
+                                                   mode=self.mode)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
         if out is None:
             return progressed or bool(self._running or len(self.queue))
         self.metrics.bump("engine_steps")
+        telemetry.flight_recorder.record_step(
+            int(telemetry.registry.counter("serving/engine_steps").value),
+            kind="serving", dur_s=time.monotonic() - t0,
+            batch=len(self._running), tokens=len(out))
         now = self.clock()
         for uid, tok in out.items():
             req = self._running.get(uid)

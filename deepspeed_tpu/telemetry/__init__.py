@@ -15,10 +15,32 @@ flops profiler, serving histograms); this package gives them one spine:
 - :mod:`~deepspeed_tpu.telemetry.summarize` — the trace self-time CLI
   (``python -m deepspeed_tpu.telemetry.summarize`` / ``bin/dstpu-trace``).
 
+The diagnostics layer on top of that spine (PR 4) answers "why did the
+run die, hang, or slow down":
+
+- :mod:`~deepspeed_tpu.telemetry.flight_recorder` — always-on bounded
+  ring of per-step records, serialized to a JSON black box on crash /
+  preemption / hang / demand;
+- :mod:`~deepspeed_tpu.telemetry.watchdog` — per-step deadline monitor
+  that dumps all-thread stacks + the black box on a hung step;
+- :mod:`~deepspeed_tpu.telemetry.compile_monitor` — XLA compile
+  counts/durations and the recompilation-storm detector;
+- :mod:`~deepspeed_tpu.telemetry.anomaly` — non-finite / loss-spike /
+  grad-outlier / step-time-regression flags on the step stream;
+- :mod:`~deepspeed_tpu.telemetry.doctor` — the ``dstpu-doctor`` CLI
+  that turns per-host black boxes into a health report.
+
 See docs/observability.md for the config reference, the trace-capture
-workflow, and the metric-name catalog.
+workflow, the metric-name catalog, and post-mortem debugging.
 """
 
+from deepspeed_tpu.telemetry.anomaly import (AnomalyDetector,  # noqa: F401
+                                             anomaly_detector,
+                                             first_flagged_path)
+from deepspeed_tpu.telemetry.compile_monitor import (  # noqa: F401
+    CompileMonitor, compile_monitor)
+from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder, flight_recorder, load_dump)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               Histogram, MetricsRegistry,
                                               registry)
@@ -27,11 +49,14 @@ from deepspeed_tpu.telemetry.sampler import (MemorySampler,  # noqa: F401
                                              host_rss_bytes, mfu,
                                              peak_flops)
 from deepspeed_tpu.telemetry.tracer import Tracer, tracer  # noqa: F401
+from deepspeed_tpu.telemetry.watchdog import Watchdog  # noqa: F401
 
 __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "Gauge", "Histogram", "MemorySampler", "peak_flops", "mfu",
            "device_memory_stats", "host_rss_bytes", "configure",
-           "metrics_text"]
+           "metrics_text", "flight_recorder", "FlightRecorder",
+           "load_dump", "Watchdog", "compile_monitor", "CompileMonitor",
+           "anomaly_detector", "AnomalyDetector", "first_flagged_path"]
 
 
 def configure(telemetry_config) -> None:

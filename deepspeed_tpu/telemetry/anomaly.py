@@ -1,0 +1,184 @@
+"""Step-stream anomaly detection: non-finite values, loss spikes,
+grad-norm outliers, step-time regressions.
+
+The engine feeds host-side step statistics in here (at monitor-flush
+cadence, so no extra device syncs); each flagged anomaly becomes a tracer
+instant, a registry counter bump, and a flight-recorder event, which is
+how ``dstpu-doctor`` reconstructs the anomaly timeline after a run dies.
+
+Detectors are deliberately simple and stateless-ish (rolling windows, no
+learned baselines): the goal is "the run went sideways at step 4312, the
+first bad leaf was ``params['decoder']['layers_7']['mlp']['wi']``", not a
+forecasting system.
+"""
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+#: rolling-window length for spike/z-score baselines
+DEFAULT_WINDOW = 64
+#: |z| above which a grad-norm sample is flagged
+GRAD_NORM_Z_THRESHOLD = 6.0
+#: loss must exceed window mean by this factor (and 3 sigma) to flag
+LOSS_SPIKE_FACTOR = 2.0
+#: step time above this multiple of the rolling median flags a regression
+STEP_TIME_REGRESSION_FACTOR = 2.5
+#: warm-up samples before spike/z-score/regression detectors arm
+MIN_SAMPLES = 8
+
+
+def first_flagged_path(flags: Any) -> Optional[str]:
+    """Name the first truthy leaf of a pytree of per-leaf flags (the
+    output of ``loss_scaler.global_check``) — e.g.
+    ``['decoder']['layers_7']['mlp']['wi']``. Returns None when clean."""
+    try:
+        from jax import tree_util
+        leaves = tree_util.tree_flatten_with_path(flags)[0]
+        for path, leaf in leaves:
+            try:
+                if bool(leaf):
+                    return tree_util.keystr(path)
+            except Exception:
+                import numpy as np
+                if bool(np.any(np.asarray(leaf))):
+                    return tree_util.keystr(path)
+    except Exception:
+        pass
+    return None
+
+
+class AnomalyDetector:
+    """Rolling-window detector over the per-step (loss, grad_norm,
+    step_time) stream. Thread-safe; all sinks best-effort."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self._lock = threading.Lock()
+        self._loss: deque = deque(maxlen=window)
+        self._grad_norm: deque = deque(maxlen=window)
+        self._step_time: deque = deque(maxlen=window)
+        self.anomalies: List[Dict[str, Any]] = []
+        self._max_anomalies = 256
+
+    # -- core ----------------------------------------------------------------
+
+    def _flag(self, kind: str, step: Optional[int], value: Any = None,
+              detail: str = "") -> Dict[str, Any]:
+        rec = {"kind": kind, "step": step, "ts": time.time()}
+        if value is not None:
+            rec["value"] = value if isinstance(value, (int, float, str)) \
+                else repr(value)
+        if detail:
+            rec["detail"] = detail
+        with self._lock:
+            self.anomalies.append(rec)
+            del self.anomalies[:-self._max_anomalies]
+        logger.warning(f"ANOMALY[{kind}] step={step} value={value} {detail}")
+        try:
+            from deepspeed_tpu.telemetry.registry import registry
+            registry.counter("anomaly/count").inc()
+            registry.counter(f"anomaly/{kind}").inc()
+        except Exception:
+            pass
+        try:
+            from deepspeed_tpu.telemetry.tracer import tracer
+            tracer.instant(f"anomaly/{kind}", step=step, detail=detail)
+        except Exception:
+            pass
+        try:
+            from deepspeed_tpu.telemetry.flight_recorder import \
+                flight_recorder
+            flight_recorder.record_event("anomaly", anomaly=kind, step=step,
+                                         value=rec.get("value"),
+                                         detail=detail or None)
+        except Exception:
+            pass
+        return rec
+
+    @staticmethod
+    def _stats(window) -> Optional[Dict[str, float]]:
+        vals = [v for v in window if math.isfinite(v)]
+        if len(vals) < MIN_SAMPLES:
+            return None
+        mean = sum(vals) / len(vals)
+        var = sum((v - mean) ** 2 for v in vals) / len(vals)
+        med = sorted(vals)[len(vals) // 2]
+        return {"mean": mean, "std": math.sqrt(var), "median": med}
+
+    # -- ingestion ------------------------------------------------------------
+
+    def observe(self, step: int, loss: Optional[float] = None,
+                grad_norm: Optional[float] = None,
+                step_time_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one step's host-side scalars; returns anomalies flagged by
+        this call. Baselines update *after* the checks, so a spike doesn't
+        instantly poison its own baseline."""
+        out: List[Dict[str, Any]] = []
+        if loss is not None:
+            loss = float(loss)
+            if not math.isfinite(loss):
+                out.append(self._flag("nonfinite_loss", step, loss))
+            else:
+                s = self._stats(self._loss)
+                if s and loss > s["mean"] * LOSS_SPIKE_FACTOR and \
+                        loss > s["mean"] + 3.0 * s["std"]:
+                    out.append(self._flag(
+                        "loss_spike", step, loss,
+                        f"window mean {s['mean']:.4g}"))
+            self._loss.append(loss)
+        if grad_norm is not None:
+            grad_norm = float(grad_norm)
+            if not math.isfinite(grad_norm):
+                out.append(self._flag("nonfinite_grad", step, grad_norm))
+            else:
+                s = self._stats(self._grad_norm)
+                if s and s["std"] > 0 and \
+                        abs(grad_norm - s["mean"]) / s["std"] > \
+                        GRAD_NORM_Z_THRESHOLD:
+                    z = (grad_norm - s["mean"]) / s["std"]
+                    out.append(self._flag(
+                        "grad_norm_outlier", step, grad_norm, f"z={z:.1f}"))
+            self._grad_norm.append(grad_norm)
+        if step_time_ms is not None:
+            step_time_ms = float(step_time_ms)
+            s = self._stats(self._step_time)
+            if s and s["median"] > 0 and \
+                    step_time_ms > s["median"] * STEP_TIME_REGRESSION_FACTOR:
+                out.append(self._flag(
+                    "step_time_regression", step, step_time_ms,
+                    f"rolling median {s['median']:.1f}ms"))
+            self._step_time.append(step_time_ms)
+        return out
+
+    def report_nonfinite(self, step: int, leaf_path: Optional[str],
+                         what: str = "grads") -> Dict[str, Any]:
+        """Record a non-finite pytree hit from the engine's scoped check,
+        naming the first offending leaf."""
+        detail = f"first non-finite leaf in {what}: {leaf_path}" \
+            if leaf_path else f"non-finite values in {what}"
+        return self._flag(f"nonfinite_{what}", step, detail=detail)
+
+    # -- export ---------------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for a in self.anomalies:
+                counts[a["kind"]] = counts.get(a["kind"], 0) + 1
+            return {"total": len(self.anomalies), "by_kind": counts,
+                    "anomalies": list(self.anomalies)}
+
+    def clear(self) -> None:
+        with self._lock:
+            del self.anomalies[:]
+            self._loss.clear()
+            self._grad_norm.clear()
+            self._step_time.clear()
+
+
+#: process-wide anomaly detector
+anomaly_detector = AnomalyDetector()

@@ -1,0 +1,180 @@
+"""XLA compilation observability: compile counts/durations and a
+recompilation-storm detector.
+
+JAX recompiles silently — a drifting input shape, a weak-typed scalar, or
+a serving request outside every bucket each cost seconds-to-minutes of
+XLA time that show up only as mysterious step-time spikes. This module
+makes each compile loud and attributable:
+
+- ``install()`` subscribes to :mod:`jax.monitoring` duration events
+  (``/jax/core/compile/backend_compile_duration`` et al.), mirroring them
+  into ``compile/count`` + ``compile/time_ms`` registry metrics and
+  tracer complete-spans.
+- Per-function attribution: ``jax.monitoring`` events carry no function
+  identity, so call sites mark cache misses explicitly via
+  :meth:`count_trace` (e.g. ``inference/engine_v2`` on a jit-cache-key
+  miss, attributing the compile to the request's bucket shape) or wrap a
+  function with :meth:`instrument` — the wrapper body only executes while
+  jax is *tracing*, i.e. exactly once per compilation cache miss.
+- Storm detection: when one function/site retraces more than
+  ``storm_threshold`` times, a single loud warning fires and the storm is
+  recorded for the flight recorder / ``dstpu-doctor``.
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_STORM_THRESHOLD = 8
+
+#: jax.monitoring duration events that mean "time spent compiling"
+_COMPILE_EVENT_MARKERS = ("compile", "lowering", "jaxpr_to_mlir")
+
+
+class CompileMonitor:
+    """Process-wide compile tracker (counterpart of ``tracer``/``registry``)."""
+
+    def __init__(self, storm_threshold: int = DEFAULT_STORM_THRESHOLD):
+        self._lock = threading.Lock()
+        self.storm_threshold = storm_threshold
+        self._installed = False
+        # jax.monitoring offers no per-listener unregister (only a global
+        # clear), so the listener stays registered and checks this flag
+        self._active = False
+        self._events: Dict[str, Dict[str, float]] = {}
+        self._functions: Dict[str, int] = {}
+        self._details: Dict[str, List[Any]] = {}
+        self._storms: List[str] = []
+
+    # -- jax.monitoring bridge ----------------------------------------------
+
+    def install(self, storm_threshold: Optional[int] = None) -> None:
+        """Subscribe to jax compile-duration events. Idempotent."""
+        if storm_threshold is not None:
+            self.storm_threshold = storm_threshold
+        self._active = True
+        if self._installed:
+            return
+        self._installed = True
+        try:
+            from jax import monitoring as jax_monitoring
+            jax_monitoring.register_event_duration_secs_listener(
+                self._on_event_duration)
+        except Exception as e:  # pragma: no cover - very old jax
+            logger.warning(f"compile monitor: jax.monitoring unavailable "
+                           f"({e}); only explicit count_trace/instrument "
+                           f"call sites will be tracked")
+
+    def uninstall(self) -> None:
+        self._active = False
+
+    def _on_event_duration(self, event: str, duration_secs: float,
+                           **kwargs: Any) -> None:
+        if not self._active:
+            return
+        if not any(m in event for m in _COMPILE_EVENT_MARKERS):
+            return
+        short = event.rsplit("/", 1)[-1]
+        with self._lock:
+            agg = self._events.setdefault(short, {"count": 0, "time_ms": 0.0})
+            agg["count"] += 1
+            agg["time_ms"] += duration_secs * 1e3
+        try:
+            from deepspeed_tpu.telemetry.registry import registry
+            registry.counter("compile/count").inc()
+            registry.histogram("compile/time_ms", lo=0.01,
+                               hi=600_000.0).record(duration_secs * 1e3)
+        except Exception:
+            pass
+        try:
+            from deepspeed_tpu.telemetry.tracer import tracer
+            now = tracer.now()
+            tracer.complete(f"compile/{short}", now - duration_secs, now)
+        except Exception:
+            pass
+
+    # -- per-function attribution -------------------------------------------
+
+    def count_trace(self, name: str, detail: Any = None) -> int:
+        """Record one (re)compilation of ``name``; returns the new count.
+        ``detail`` (e.g. the serving bucket shape that missed the jit
+        cache) is kept so ``dstpu-doctor`` can show *what* keeps changing."""
+        with self._lock:
+            n = self._functions.get(name, 0) + 1
+            self._functions[name] = n
+            if detail is not None:
+                self._details.setdefault(name, []).append(detail)
+                del self._details[name][:-16]
+            storm = n == self.storm_threshold + 1 and name not in self._storms
+            if storm:
+                self._storms.append(name)
+            details = list(self._details.get(name, ()))
+        try:
+            from deepspeed_tpu.telemetry.registry import registry
+            registry.counter(f"compile/retrace/{name}").inc()
+        except Exception:
+            pass
+        if storm:
+            logger.warning(
+                f"RECOMPILATION STORM: {name!r} has been traced {n} times "
+                f"(threshold {self.storm_threshold}) — every retrace pays "
+                f"full XLA compile time. Recent trigger details: "
+                f"{details or 'n/a'}. Check for drifting shapes, weak-typed "
+                f"scalars, or serving requests that fall outside every "
+                f"bucket.")
+            try:
+                from deepspeed_tpu.telemetry.flight_recorder import \
+                    flight_recorder
+                flight_recorder.record_event("recompile_storm", name=name,
+                                             count=n, details=details)
+            except Exception:
+                pass
+            try:
+                from deepspeed_tpu.telemetry.tracer import tracer
+                tracer.instant(f"compile/storm/{name}")
+            except Exception:
+                pass
+        return n
+
+    def instrument(self, fn: Callable, name: Optional[str] = None) -> Callable:
+        """Wrap ``fn`` so each jax *trace* of it is counted. The wrapper
+        body runs only while jax traces (cache miss / retrace); cached
+        executions never enter it, so steady state pays nothing."""
+        label = name or getattr(fn, "__name__", repr(fn))
+
+        def traced(*args, **kwargs):
+            self.count_trace(label)
+            return fn(*args, **kwargs)
+
+        traced.__name__ = getattr(fn, "__name__", "traced")
+        traced.__wrapped__ = fn
+        return traced
+
+    # -- export --------------------------------------------------------------
+
+    def retrace_count(self, name: str) -> int:
+        with self._lock:
+            return self._functions.get(name, 0)
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "events": {k: dict(v) for k, v in self._events.items()},
+                "functions": dict(self._functions),
+                "details": {k: list(v) for k, v in self._details.items()},
+                "storms": list(self._storms),
+                "storm_threshold": self.storm_threshold,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._functions.clear()
+            self._details.clear()
+            del self._storms[:]
+
+
+#: process-wide compile monitor
+compile_monitor = CompileMonitor()

@@ -1,0 +1,307 @@
+"""``dstpu-doctor``: post-mortem health reports from flight-recorder
+black boxes.
+
+Feed it one or many per-host dumps (plus optional watchdog heartbeat
+files) and it prints what an on-call engineer wants first:
+
+- where the run stopped (last completed step per host) and why
+  (exception / watchdog / preemption / nothing recorded);
+- per-step timing and the slowest host per step (straggler skew);
+- achieved vs **algorithmic** collective bandwidth — byte counts come
+  from trace-time recording, converted per op with
+  :func:`~deepspeed_tpu.comm.comms_logger.get_msg_size` (ring all-reduce
+  moves ``2(w-1)/w`` of the payload per rank, all-gather ``(w-1)/w``);
+- recompile storms and the anomaly timeline;
+- a plain-language verdict, ranked crash > hang > non-finite > straggler
+  > recompile storm > healthy.
+
+Usage::
+
+    dstpu-doctor host0_blackbox.json host1_blackbox.json
+    python -m deepspeed_tpu.telemetry.doctor --json dump.json
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.comm.comms_logger import convert_size, get_msg_size
+from deepspeed_tpu.telemetry.flight_recorder import load_dump
+
+#: slowest-host mean step time must exceed the fastest by this factor
+#: before the verdict calls out a straggler
+STRAGGLER_SKEW_FACTOR = 1.5
+
+
+def _host_name(doc: Dict[str, Any], idx: int) -> str:
+    meta = doc.get("meta", {})
+    host = meta.get("hostname") or f"host{idx}"
+    pi = meta.get("process_index")
+    return f"{host}[p{pi}]" if pi is not None else host
+
+
+def _mean(vals: List[float]) -> Optional[float]:
+    return sum(vals) / len(vals) if vals else None
+
+
+def analyze(dumps: List[Dict[str, Any]],
+            heartbeats: Optional[List[Dict[str, Any]]] = None
+            ) -> Dict[str, Any]:
+    """Pure analysis: per-host dumps → structured report dict."""
+    hosts = []
+    for i, doc in enumerate(dumps):
+        steps = doc.get("steps", [])
+        durs = [s["dur_ms"] for s in steps
+                if isinstance(s.get("dur_ms"), (int, float))]
+        watchdog_events = [e for e in doc.get("events", [])
+                           if e.get("kind") == "watchdog"]
+        preempt_events = [e for e in doc.get("events", [])
+                          if e.get("kind") == "preemption"]
+        hosts.append({
+            "name": _host_name(doc, i),
+            "reason": doc.get("reason"),
+            "last_step": steps[-1]["step"] if steps else None,
+            "n_steps": len(steps),
+            "mean_step_ms": _mean(durs),
+            "max_step_ms": max(durs) if durs else None,
+            "exception": doc.get("exception"),
+            "watchdog": watchdog_events,
+            "preemption": preempt_events,
+            "storms": (doc.get("compile") or {}).get("storms", []),
+            "compile_functions": (doc.get("compile") or {}).get(
+                "functions", {}),
+        })
+
+    # -- straggler skew: per-step slowest host over steps seen everywhere
+    per_step: Dict[int, Dict[str, float]] = {}
+    for i, doc in enumerate(dumps):
+        name = _host_name(doc, i)
+        for s in doc.get("steps", []):
+            if isinstance(s.get("dur_ms"), (int, float)):
+                per_step.setdefault(s["step"], {})[name] = s["dur_ms"]
+    slowest_counts: Dict[str, int] = {}
+    shared_steps = {k: v for k, v in per_step.items() if len(v) > 1}
+    for step, by_host in shared_steps.items():
+        slowest_counts[max(by_host, key=by_host.get)] = \
+            slowest_counts.get(max(by_host, key=by_host.get), 0) + 1
+    straggler = None
+    means = {h["name"]: h["mean_step_ms"] for h in hosts
+             if h["mean_step_ms"]}
+    if len(means) > 1:
+        slow = max(means, key=means.get)
+        fast = min(means, key=means.get)
+        skew = means[slow] / means[fast] if means[fast] > 0 else 1.0
+        straggler = {"host": slow, "skew": skew,
+                     "slow_mean_ms": means[slow],
+                     "fast_mean_ms": means[fast],
+                     "slowest_step_counts": slowest_counts,
+                     "significant": skew >= STRAGGLER_SKEW_FACTOR}
+
+    # -- stalled heartbeat naming (multi-host hang: the host whose step
+    # counter stopped advancing, or whose phase says "stalled")
+    stalled = []
+    for hb in heartbeats or []:
+        if hb.get("phase") == "stalled":
+            stalled.append({"host": hb.get("hostname"),
+                            "step": hb.get("step"),
+                            "label": hb.get("label")})
+
+    # -- collective bandwidth: algorithmic bytes via get_msg_size over
+    # recorded per-op time; zero recorded time (trace-time logging under
+    # jit) falls back to total stepped wall time as an UPPER BOUND
+    world = max([d.get("meta", {}).get("process_count") or 1
+                 for d in dumps] + [len(dumps)])
+    bandwidth = []
+    for i, doc in enumerate(dumps):
+        total_step_s = sum(s["dur_ms"] for s in doc.get("steps", [])
+                           if isinstance(s.get("dur_ms"), (int, float))
+                           ) / 1e3
+        for op, sizes in (doc.get("comm") or {}).items():
+            alg_bytes = 0
+            raw_bytes = 0
+            t = 0.0
+            calls = 0
+            for size, (count, total_t) in sizes.items():
+                alg_bytes += get_msg_size(op, int(size), world) * count
+                raw_bytes += int(size) * count
+                t += total_t
+                calls += count
+            row = {"host": _host_name(doc, i), "op": op, "calls": calls,
+                   "raw_bytes": raw_bytes, "algorithmic_bytes": alg_bytes}
+            if t > 0:
+                row["achieved_gbps"] = alg_bytes / t / 1e9
+            elif total_step_s > 0:
+                row["achieved_gbps_upper_bound"] = \
+                    alg_bytes / total_step_s / 1e9
+            bandwidth.append(row)
+
+    # -- anomaly timeline across hosts
+    timeline = []
+    for i, doc in enumerate(dumps):
+        for e in doc.get("events", []):
+            if e.get("kind") == "anomaly":
+                timeline.append({**e, "host": _host_name(doc, i)})
+    timeline.sort(key=lambda e: (e.get("ts", 0.0), e.get("step") or 0))
+    nonfinite = [e for e in timeline
+                 if str(e.get("anomaly", "")).startswith("nonfinite")]
+
+    # -- verdict, most fatal condition first
+    crashed = [h for h in hosts if h["exception"]]
+    hung = [h for h in hosts if h["watchdog"]]
+    preempted = [h for h in hosts if h["preemption"]]
+    storms = sorted({s for h in hosts for s in h["storms"]})
+    if crashed:
+        h = crashed[0]
+        verdict = (f"CRASH on {h['name']} after step {h['last_step']}: "
+                   f"{h['exception']['type']}: "
+                   f"{h['exception']['message'][:200]}")
+    elif hung or stalled:
+        if stalled:
+            s = stalled[0]
+            verdict = (f"HANG: host {s['host']} stalled at step "
+                       f"{s['step']} ({s['label']}) — see its watchdog "
+                       f"stack dump")
+        else:
+            h = hung[0]
+            ev = h["watchdog"][0]
+            verdict = (f"HANG on {h['name']}: step {ev.get('step')} "
+                       f"({ev.get('label')}) missed the "
+                       f"{ev.get('timeout_s')}s watchdog deadline")
+    elif preempted:
+        h = preempted[0]
+        verdict = (f"PREEMPTED on {h['name']} at step {h['last_step']} "
+                   f"(checkpoint tag "
+                   f"{h['preemption'][0].get('checkpoint_tag')!r})")
+    elif nonfinite:
+        e = nonfinite[0]
+        verdict = (f"NON-FINITE values from step {e.get('step')} on "
+                   f"{e['host']}: {e.get('detail') or e.get('anomaly')}")
+    elif straggler and straggler["significant"]:
+        verdict = (f"STRAGGLER: {straggler['host']} runs "
+                   f"{straggler['skew']:.2f}x slower than the fastest "
+                   f"host ({straggler['slow_mean_ms']:.1f}ms vs "
+                   f"{straggler['fast_mean_ms']:.1f}ms mean step)")
+    elif storms:
+        verdict = (f"RECOMPILATION STORM: {', '.join(storms)} — check "
+                   f"for drifting shapes or out-of-bucket requests")
+    elif timeline:
+        verdict = (f"COMPLETED WITH ANOMALIES: {len(timeline)} flagged "
+                   f"(first: {timeline[0].get('anomaly')} at step "
+                   f"{timeline[0].get('step')})")
+    else:
+        verdict = "HEALTHY: no crash, hang, anomaly, or storm recorded"
+
+    return {"hosts": hosts, "straggler": straggler, "stalled": stalled,
+            "bandwidth": bandwidth, "anomalies": timeline,
+            "storms": storms, "world": world, "verdict": verdict}
+
+
+def render(report: Dict[str, Any]) -> str:
+    """Structured report → plain-text health report."""
+    out: List[str] = []
+    out.append("== dstpu-doctor report ==")
+    out.append(f"VERDICT: {report['verdict']}")
+    out.append("")
+    out.append(f"{'host':<24}{'last step':>10}{'steps':>7}"
+               f"{'mean ms':>10}{'max ms':>10}  status")
+    for h in report["hosts"]:
+        if h["exception"]:
+            status = f"crashed ({h['exception']['type']})"
+        elif h["watchdog"]:
+            status = "hung (watchdog fired)"
+        elif h["preemption"]:
+            status = "preempted"
+        else:
+            status = "ok"
+        mean = f"{h['mean_step_ms']:.1f}" if h["mean_step_ms"] else "-"
+        mx = f"{h['max_step_ms']:.1f}" if h["max_step_ms"] else "-"
+        last = h["last_step"] if h["last_step"] is not None else "-"
+        out.append(f"{h['name']:<24}{last!s:>10}{h['n_steps']:>7}"
+                   f"{mean:>10}{mx:>10}  {status}")
+    st = report["straggler"]
+    if st:
+        out.append("")
+        out.append(f"straggler skew: {st['host']} is {st['skew']:.2f}x "
+                   f"the fastest host"
+                   + (" (SIGNIFICANT)" if st["significant"] else ""))
+        for host, n in sorted(st["slowest_step_counts"].items(),
+                              key=lambda kv: -kv[1]):
+            out.append(f"  slowest on {n} shared steps: {host}")
+    if report["bandwidth"]:
+        out.append("")
+        out.append(f"collective bandwidth (world={report['world']}, "
+                   f"algorithmic bytes via get_msg_size):")
+        out.append(f"  {'host':<24}{'op':<16}{'calls':>7}"
+                   f"{'alg bytes':>12}{'GB/s':>10}")
+        for b in report["bandwidth"]:
+            if "achieved_gbps" in b:
+                bw = f"{b['achieved_gbps']:.2f}"
+            elif "achieved_gbps_upper_bound" in b:
+                bw = f"<={b['achieved_gbps_upper_bound']:.2f}"
+            else:
+                bw = "-"
+            out.append(f"  {b['host']:<24}{b['op']:<16}{b['calls']:>7}"
+                       f"{convert_size(b['algorithmic_bytes']):>12}"
+                       f"{bw:>10}")
+    if report["storms"]:
+        out.append("")
+        out.append(f"recompile storms: {', '.join(report['storms'])}")
+    if report["anomalies"]:
+        out.append("")
+        out.append("anomaly timeline:")
+        for e in report["anomalies"][:50]:
+            out.append(f"  step {e.get('step')!s:>8} {e['host']:<24}"
+                       f"{e.get('anomaly', '?'):<22}"
+                       f"{e.get('detail') or e.get('value') or ''}")
+        if len(report["anomalies"]) > 50:
+            out.append(f"  ... {len(report['anomalies']) - 50} more")
+    out.append("")
+    return "\n".join(out)
+
+
+def _load_any(path: str):
+    """Flight-recorder dump or watchdog heartbeat file (small JSON with a
+    ``phase`` key) — the doctor takes both on one command line."""
+    try:
+        return "dump", load_dump(path)
+    except ValueError:
+        with open(path) as fh:
+            doc = json.load(fh)
+        if isinstance(doc, dict) and "phase" in doc:
+            return "heartbeat", doc
+        raise
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-doctor",
+        description="Post-mortem health report from flight-recorder "
+                    "black boxes (and optional heartbeat files).")
+    ap.add_argument("paths", nargs="+",
+                    help="per-host black-box JSONs / heartbeat files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the structured report as JSON")
+    args = ap.parse_args(argv)
+    dumps, heartbeats = [], []
+    for p in args.paths:
+        try:
+            kind, doc = _load_any(p)
+        except Exception as e:
+            print(f"dstpu-doctor: cannot read {p}: {e}", file=sys.stderr)
+            return 2
+        (dumps if kind == "dump" else heartbeats).append(doc)
+    if not dumps:
+        print("dstpu-doctor: no flight-recorder dumps among the inputs",
+              file=sys.stderr)
+        return 2
+    report = analyze(dumps, heartbeats)
+    if args.json:
+        print(json.dumps(report, indent=1, default=repr))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,17 @@ def test_elastic_agent_checkpoints_on_signal(tmp_path, devices):
             agent.step_boundary()
         tag = exc.value.tag
         assert (tmp_path / tag / "meta.p0.json").exists()
+        # the exit path also dumps the flight recorder next to the
+        # checkpoint and carries the path on the exception, so the
+        # relaunch operator finds both artifacts in one log line
+        blackbox = exc.value.blackbox_path
+        assert blackbox and os.path.exists(blackbox)
+        from deepspeed_tpu.telemetry.flight_recorder import load_dump
+        doc = load_dump(blackbox)
+        assert doc["reason"] == "preemption"
+        assert any(e.get("kind") == "preemption" and
+                   e.get("checkpoint_tag") == tag
+                   for e in doc["events"])
     finally:
         agent.uninstall()
 

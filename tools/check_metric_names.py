@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Metric-name lint: every ``registry.counter/gauge/histogram(...)`` call
+site with a literal name must follow the ``area/name`` convention, and no
+name may be requested as two different metric types (the registry raises
+``TypeError`` at runtime on such a collision — this catches it in CI,
+before the colliding code paths happen to run in one process).
+
+Rules (docs/observability.md "metric catalog"):
+- names are ``area/name`` — at least two ``/``-separated segments;
+- segments are lowercase ``[a-z0-9_]`` (f-string ``{placeholder}``
+  segments are allowed and normalized to ``{}``);
+- one name ↔ one metric type across the whole tree.
+
+Only literal string / f-string first arguments are checked; call sites
+passing a variable (e.g. ``gauge(name)`` in a generic flusher) are
+skipped — their names are produced by checked call sites upstream.
+
+Usage: ``python tools/check_metric_names.py [root]`` → exit 0 clean,
+exit 1 with one line per violation. Invoked from the tier-1 suite
+(tests/test_diagnostics.py) so a bad name fails CI.
+"""
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+METRIC_METHODS = ("counter", "gauge", "histogram")
+_SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
+
+
+def _literal_name(node: ast.AST) -> Optional[str]:
+    """First-arg metric name, with f-string placeholders normalized to
+    ``{}``; None when the arg isn't a (partially) literal string."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append("{}")
+        return "".join(parts)
+    return None
+
+
+def collect_sites(root: str) -> List[Tuple[str, int, str, str]]:
+    """(file, line, metric_type, normalized_name) for every literal-name
+    registry call site under ``root``."""
+    sites = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError as e:
+                    print(f"{path}: unparseable: {e}", file=sys.stderr)
+                    continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in METRIC_METHODS and node.args):
+                    continue
+                name = _literal_name(node.args[0])
+                if name is None:
+                    continue
+                sites.append((os.path.relpath(path, root), node.lineno,
+                              node.func.attr, name))
+    return sites
+
+
+def check(sites) -> List[str]:
+    errors = []
+    types_by_name: Dict[str, Set[str]] = {}
+    first_site: Dict[str, Tuple[str, int, str]] = {}
+    for path, line, mtype, name in sites:
+        segments = name.split("/")
+        if len(segments) < 2:
+            errors.append(f"{path}:{line}: metric {name!r} violates the "
+                          f"area/name convention (no '/' namespace)")
+        bad = [s for s in segments if not _SEGMENT.match(s)]
+        if bad:
+            errors.append(f"{path}:{line}: metric {name!r} has invalid "
+                          f"segment(s) {bad} (want lowercase "
+                          f"[a-z0-9_] or a placeholder)")
+        types_by_name.setdefault(name, set()).add(mtype)
+        first_site.setdefault(name, (path, line, mtype))
+        if len(types_by_name[name]) > 1:
+            fp, fl, ft = first_site[name]
+            errors.append(f"{path}:{line}: metric {name!r} requested as "
+                          f"{mtype} but first seen as {ft} at {fp}:{fl} "
+                          f"(the registry raises TypeError at runtime)")
+    return errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deepspeed_tpu")
+    sites = collect_sites(root)
+    errors = check(sites)
+    for e in errors:
+        print(e)
+    if not errors:
+        print(f"check_metric_names: {len(sites)} literal call sites OK")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
