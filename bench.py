@@ -173,7 +173,7 @@ def moe_main(args) -> None:
     achieved = (6.0 * active + attn) * tokens / dt / n_dev
     peak = _peak_flops(dev0)
     mfu = achieved / peak if peak else 0.0
-    print(json.dumps({
+    result = {
         "metric": f"tokens/sec/chip moe-8e-top2 ~1B seq{seq} dropless",
         "value": round(tokens / dt / n_dev, 2),
         "unit": "tokens/s/chip",
@@ -184,7 +184,22 @@ def moe_main(args) -> None:
                   "params_active_b": round(active / 1e9, 3),
                   "loss": loss_val, "platform": dev0.platform,
                   "n_devices": n_dev, "steps": steps,
-                  "global_batch": gb}}))
+                  "global_batch": gb}}
+    try:
+        from deepspeed_tpu.telemetry import explain as _explain
+        rep = _explain.explain_engine(
+            engine, measured_step_ms=dt / steps * 1e3)
+        rl = rep.roofline
+        result["extra"]["roofline"] = {
+            "flops_per_step": rl.flops, "bytes_per_step": rl.bytes,
+            "comm_bytes_per_step": rl.comm_bytes,
+            "predicted_step_ms": round(rl.predicted_s * 1e3, 3),
+            "bound": rl.bound,
+            "pct_of_roofline": round(rl.pct_of(dt / steps) or 0.0, 2),
+        }
+    except Exception:
+        pass
+    print(json.dumps(result))
     if getattr(args, "trace", None):
         from deepspeed_tpu.telemetry import tracer
         tracer.dump(args.trace)
@@ -346,6 +361,25 @@ def main() -> None:
             "global_batch": gb,
         },
     }
+    # compile-time roofline stamp (telemetry/explain): predicted FLOPs /
+    # bytes and % of roofline, so BENCH trajectories can distinguish
+    # "kernel got faster" from "model got smaller". Never breaks the
+    # headline line — any failure just drops the stamp.
+    try:
+        from deepspeed_tpu.telemetry import explain as _explain
+        rep = _explain.explain_engine(
+            engine, measured_step_ms=dt / steps * 1e3)
+        rl = rep.roofline
+        result["extra"]["roofline"] = {
+            "flops_per_step": rl.flops, "bytes_per_step": rl.bytes,
+            "comm_bytes_per_step": rl.comm_bytes,
+            "predicted_step_ms": round(rl.predicted_s * 1e3, 3),
+            "bound": rl.bound,
+            "pct_of_roofline": round(
+                rl.pct_of(dt / steps) or 0.0, 2),
+        }
+    except Exception:
+        pass
     if run_suite and on_tpu:
         result["extra"]["suite"] = _suite(
             os.path.dirname(os.path.abspath(__file__)))

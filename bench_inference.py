@@ -27,6 +27,23 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _roofline_extra(eng) -> dict:
+    """Compile-time prefill/decode roofline stamp (telemetry/explain)
+    for the result line's extra; {} on any failure — the stamp must
+    never break the headline JSON."""
+    try:
+        recs = eng.cost_records()
+        return {lbl: {
+            "flops": recs[lbl]["flops"],
+            "bytes_accessed": recs[lbl]["bytes_accessed"],
+            "predicted_step_ms": round(
+                recs[lbl]["predicted_s"] * 1e3, 4),
+            "bound": recs[lbl]["bound"],
+        } for lbl in ("prefill", "decode") if not recs[lbl].get("error")}
+    except Exception:
+        return {}
+
+
 def bench_shared_prefix(args) -> None:
     """serving-frontend scenario: a stream of prompts sharing a 50%
     prefix (system prompt / few-shot preamble), served through
@@ -107,6 +124,7 @@ def bench_shared_prefix(args) -> None:
             "engine_steps_nocache":
                 fe_cold.metrics.counters["engine_steps"],
             "ttft_mean_s": round(fe_hot.metrics.ttft.mean, 4),
+            "roofline": _roofline_extra(eng),
         },
     }
     print(json.dumps(result))
@@ -288,6 +306,7 @@ def main() -> None:
                 "padded_wall_ms_per_step": round(
                     t_padded_uni / uni * 1e3, 2),
             },
+            "roofline": _roofline_extra(v2),
         },
     }
     print(json.dumps(result))

@@ -384,6 +384,17 @@ class TelemetryConfig(TPUConfigModel):
     #: warn once a single function has been retraced this many times
     compile_storm_threshold: int = Field(default=8, ge=1)
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    #: serve ``GET /metrics`` + ``GET /healthz`` on this port (0 =
+    #: ephemeral; None = no server) — telemetry/endpoint.py
+    http_port: Optional[int] = Field(default=None, ge=0)
+    #: run the full compile-time explain (telemetry/explain.py) at engine
+    #: init: lowers the jitted step once more to log the roofline + HBM
+    #: budget and publish roofline/* gauges. Off by default — it costs an
+    #: extra XLA compile of the step program.
+    explain_startup: bool = False
+    #: override the per-chip peak HBM bytes/s used for the roofline
+    #: memory bound (0/None → auto from the device kind)
+    peak_hbm_bw_override: Optional[float] = Field(default=None, gt=0)
 
 
 class TensorBoardConfig(TPUConfigModel):

@@ -387,6 +387,18 @@ class RaggedInferenceEngineTPU:
         self._step_fns[key] = jitted
         return jitted
 
+    def cost_records(self, mode=("argmax",), refresh: bool = False):
+        """Compile-time cost records for the prefill/decode bucket
+        programs (telemetry/explain.py): per-program FLOPs / bytes /
+        roofline ``predicted_s``. Lazily computed and cached — the first
+        call costs two abstract XLA compiles; the frontend's SLO
+        admission reads ``predicted_s`` from here (0.0 when the platform
+        has no peak numbers, e.g. CPU)."""
+        if refresh or getattr(self, "_cost_records", None) is None:
+            from deepspeed_tpu.telemetry.explain import explain_serving
+            self._cost_records = explain_serving(self, mode=mode)
+        return self._cost_records
+
     def _page_table(self, uids: List[int], nb: int) -> np.ndarray:
         """[nb, mb] physical page ids; padding rows/entries point at the
         pool's trash sentinel (num_blocks)."""

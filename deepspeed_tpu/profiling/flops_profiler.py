@@ -13,28 +13,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+# THE cost-analysis helpers live in telemetry/explain.py (one place that
+# handles dict-vs-list cost_analysis() shapes across jax versions and
+# empty returns on CPU backends); re-exported here for API continuity.
+from deepspeed_tpu.telemetry.explain import _cost, analyze_fn  # noqa: F401
 from deepspeed_tpu.utils.logging import log_dist
-
-
-def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
-    """Compile ``fn`` for the current devices and return XLA cost analysis:
-    {'flops': ..., 'bytes accessed': ..., 'optimal_seconds': ...} (keys as
-    XLA reports them, normalized a bit)."""
-    compiled = jax.jit(fn, static_argnums=static_argnums).lower(*args).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):          # per-device list on some backends
-        cost = cost[0] if cost else {}
-    out = {"flops": float(cost.get("flops", 0.0)),
-           "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
-    try:
-        mem = compiled.memory_analysis()
-        out["peak_bytes"] = float(
-            getattr(mem, "temp_size_in_bytes", 0) +
-            getattr(mem, "argument_size_in_bytes", 0) +
-            getattr(mem, "output_size_in_bytes", 0))
-    except Exception:
-        pass
-    return out
 
 
 class FlopsProfiler:
@@ -86,15 +69,6 @@ def _abstract(tree):
     directly, so nothing is ever allocated — 70B profiles are free)."""
     return jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
-
-
-def _cost(fn, *abstract_args) -> Dict[str, float]:
-    compiled = jax.jit(fn).lower(*abstract_args).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0] if cost else {}
-    return {"flops": float(cost.get("flops", 0.0)),
-            "bytes": float(cost.get("bytes accessed", 0.0))}
 
 
 def module_profile(dec_cfg, batch_size: int = 1,

@@ -1047,6 +1047,36 @@ class DeepSpeedTPUEngine:
             heartbeat_file=wcfg.heartbeat_file or
             os.environ.get("DSTPU_HEARTBEAT_FILE") or None) \
             if wcfg.enabled else None
+        # -- compile-time explain (PR 5): the static HBM budget is always
+        # logged (pure metadata, no compile); the full roofline explain —
+        # one extra XLA compile of the step — is opt-in
+        self._roofline_predicted_s = 0.0
+        from deepspeed_tpu.telemetry import explain as _explain
+        try:
+            _explain.startup_budget(self)
+        except Exception as e:                       # noqa: BLE001
+            logger.debug(f"startup HBM budget skipped: {e}")
+        if tcfg.explain_startup:
+            try:
+                report = _explain.explain_engine(self)
+                _explain.publish_gauges(report)
+                self._roofline_predicted_s = report.roofline.predicted_s
+                log_dist("\n" + _explain.render(report))
+            except Exception as e:                   # noqa: BLE001
+                logger.warning(f"explain_startup failed (non-fatal): {e}")
+        self._metrics_server = None
+        if tcfg.http_port is not None:
+            import atexit
+            from deepspeed_tpu.telemetry.endpoint import MetricsServer
+            try:
+                self._metrics_server = MetricsServer(
+                    tcfg.http_port,
+                    heartbeat_file=wcfg.heartbeat_file or
+                    os.environ.get("DSTPU_HEARTBEAT_FILE") or None)
+                atexit.register(self._metrics_server.close)
+            except Exception as e:                   # noqa: BLE001
+                logger.warning(
+                    f"metrics endpoint on :{tcfg.http_port} failed: {e}")
 
     def _record_step_telemetry(self, dt_s: float) -> None:
         """Per-step registry metrics (always on — the registry is cheap).
@@ -1074,6 +1104,11 @@ class DeepSpeedTPUEngine:
             # monitor flush instead (see _flush_monitor)
             telemetry.anomaly_detector.observe(self.global_steps,
                                                step_time_ms=dt_s * 1e3)
+            if self._roofline_predicted_s > 0:
+                reg.gauge(
+                    "roofline/pct",
+                    help="predicted/measured step time, percent"
+                ).set(100.0 * self._roofline_predicted_s / dt_s)
         if self._mem_sampler is not None and \
                 self.global_steps % max(1, self.config.steps_per_print) == 0:
             self._mem_sampler.sample()

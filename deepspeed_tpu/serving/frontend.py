@@ -87,7 +87,8 @@ class ServingFrontend:
                  monitor=None, mode=("argmax",),
                  token_budget: Optional[int] = None,
                  emit_every: int = 0, clock=time.monotonic,
-                 watchdog=None):
+                 watchdog=None, http_port: Optional[int] = None,
+                 slo_admission: bool = False):
         self.engine = engine
         #: optional telemetry.Watchdog armed around each engine step — a
         #: hung decode (deadlocked collective, runaway compile) dumps
@@ -105,6 +106,56 @@ class ServingFrontend:
         self.emit_every = emit_every
         self.clock = clock                   # injectable for deadline tests
         self._running: Dict[int, Request] = {}
+        #: compile-time prefill/decode cost records (telemetry/explain) —
+        #: SLO admission reads predicted step times from here; tests
+        #: inject synthetic records directly
+        self.cost_records: Optional[Dict[str, Any]] = None
+        if slo_admission:
+            try:
+                self.cost_records = engine.cost_records(mode=mode)
+            except Exception as e:               # noqa: BLE001
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(f"SLO admission disabled — cost records "
+                               f"unavailable: {e}")
+        self._http = None
+        if http_port is not None:
+            from deepspeed_tpu.telemetry.endpoint import MetricsServer
+            self._http = MetricsServer(http_port)
+
+    def close(self) -> None:
+        """Release frontend-owned resources (the /metrics server);
+        idempotent, safe to call on a frontend that never opened one."""
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+
+    def _slo_check(self, req: Request, now: float) -> None:
+        """Reject at the door when the roofline says the deadline is
+        unattainable even on an idle engine: best-case latency =
+        ceil(prompt/prefill_chunk) prefill steps + max_new_tokens decode
+        steps at their predicted step times. Zero predictions (CPU, no
+        peak table) disable the check — admission behavior is unchanged
+        where there is no model."""
+        recs = self.cost_records
+        if recs is None or req.deadline is None:
+            return
+        t_pre = float(recs.get("prefill", {}).get("predicted_s", 0.0))
+        t_dec = float(recs.get("decode", {}).get("predicted_s", 0.0))
+        if t_pre <= 0.0 or t_dec <= 0.0:
+            return
+        chunk = max(1, int(self.engine.config.prefill_chunk))
+        best = -(-len(req.prompt) // chunk) * t_pre + \
+            req.max_new_tokens * t_dec
+        if now + best > req.deadline:
+            req.state = RequestState.REJECTED
+            req.finish_reason = "slo_unattainable"
+            self.metrics.bump("rejected_slo")
+            raise AdmissionError(
+                "slo_unattainable",
+                f"best-case {best * 1e3:.1f} ms exceeds deadline "
+                f"{(req.deadline - now) * 1e3:.1f} ms away "
+                f"(roofline: prefill {t_pre * 1e3:.2f} ms/step, "
+                f"decode {t_dec * 1e3:.2f} ms/step)")
 
     # -- admission ----------------------------------------------------------
 
@@ -113,8 +164,11 @@ class ServingFrontend:
                deadline: Optional[float] = None,
                stream_cb=None) -> Request:
         """Admit a request or raise :class:`AdmissionError` with a reason
-        (``queue_full`` | ``kv_exhausted`` | ``too_long``) — overload is
-        surfaced at the door, not buffered into unbounded latency."""
+        (``queue_full`` | ``kv_exhausted`` | ``too_long`` |
+        ``slo_unattainable``) — overload is surfaced at the door, not
+        buffered into unbounded latency. ``slo_unattainable`` fires only
+        with SLO admission on and a deadline the roofline model says
+        cannot be met even best-case."""
         now = self.clock()
         prompt = [int(t) for t in prompt]
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
@@ -139,6 +193,7 @@ class ServingFrontend:
             self.metrics.bump("rejected_kv_exhausted")
             raise AdmissionError(
                 "kv_exhausted", f"need {need} pages, {avail} reclaimable")
+        self._slo_check(req, now)
         try:
             self.queue.submit(req, now)
         except AdmissionError:

@@ -46,7 +46,7 @@ class ServingMetrics:
         self.counters: Dict[str, int] = {
             "admitted": 0, "completed": 0, "cancelled": 0, "shed": 0,
             "rejected_queue_full": 0, "rejected_kv_exhausted": 0,
-            "rejected_too_long": 0, "tokens_out": 0,
+            "rejected_too_long": 0, "rejected_slo": 0, "tokens_out": 0,
             "prefix_tokens_reused": 0, "engine_steps": 0,
         }
 

@@ -30,6 +30,16 @@ run die, hang, or slow down":
 - :mod:`~deepspeed_tpu.telemetry.doctor` — the ``dstpu-doctor`` CLI
   that turns per-host black boxes into a health report.
 
+The compile-time side (PR 5) answers "where was this step ALWAYS going
+to spend its FLOPs, bytes, and HBM" before it runs:
+
+- :mod:`~deepspeed_tpu.telemetry.explain` — lowers the jitted step /
+  serving programs, reads back XLA cost+memory analysis, and builds the
+  roofline + HBM-budget report (``bin/dstpu-explain``, ``roofline/*``
+  gauges);
+- :mod:`~deepspeed_tpu.telemetry.endpoint` — the live scrape server
+  (``GET /metrics`` + ``GET /healthz``), ``telemetry.http_port`` config.
+
 See docs/observability.md for the config reference, the trace-capture
 workflow, the metric-name catalog, and post-mortem debugging.
 """
@@ -39,6 +49,14 @@ from deepspeed_tpu.telemetry.anomaly import (AnomalyDetector,  # noqa: F401
                                              first_flagged_path)
 from deepspeed_tpu.telemetry.compile_monitor import (  # noqa: F401
     CompileMonitor, compile_monitor)
+from deepspeed_tpu.telemetry.endpoint import MetricsServer  # noqa: F401
+from deepspeed_tpu.telemetry.explain import (ExplainReport,  # noqa: F401
+                                             FunctionCost, Roofline,
+                                             analyze_fn, explain_engine,
+                                             explain_serving,
+                                             normalize_cost_analysis,
+                                             publish_gauges, render,
+                                             resolve_peaks)
 from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
     FlightRecorder, flight_recorder, load_dump)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
@@ -56,7 +74,10 @@ __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "device_memory_stats", "host_rss_bytes", "configure",
            "metrics_text", "flight_recorder", "FlightRecorder",
            "load_dump", "Watchdog", "compile_monitor", "CompileMonitor",
-           "anomaly_detector", "AnomalyDetector", "first_flagged_path"]
+           "anomaly_detector", "AnomalyDetector", "first_flagged_path",
+           "ExplainReport", "FunctionCost", "Roofline", "analyze_fn",
+           "explain_engine", "explain_serving", "normalize_cost_analysis",
+           "publish_gauges", "render", "resolve_peaks", "MetricsServer"]
 
 
 def configure(telemetry_config) -> None:

@@ -72,6 +72,19 @@ def analyze(dumps: List[Dict[str, Any]],
             "compile_functions": (doc.get("compile") or {}).get(
                 "functions", {}),
         })
+        # predicted vs achieved: when the black box carries an explain
+        # snapshot (telemetry/explain.py), compare its roofline
+        # prediction against this host's measured mean step time
+        exp = (doc.get("explain") or {}).get("train") or {}
+        pred_ms = ((exp.get("roofline") or {}).get("predicted_ms")
+                   or 0.0)
+        if pred_ms > 0:
+            row = {"predicted_ms": pred_ms,
+                   "bound": (exp.get("roofline") or {}).get("bound")}
+            mean = hosts[-1]["mean_step_ms"]
+            if mean:
+                row["pct_of_roofline"] = 100.0 * pred_ms / mean
+            hosts[-1]["roofline"] = row
 
     # -- straggler skew: per-step slowest host over steps seen everywhere
     per_step: Dict[int, Dict[str, float]] = {}
@@ -244,6 +257,18 @@ def render(report: Dict[str, Any]) -> str:
             out.append(f"  {b['host']:<24}{b['op']:<16}{b['calls']:>7}"
                        f"{convert_size(b['algorithmic_bytes']):>12}"
                        f"{bw:>10}")
+    rl_hosts = [h for h in report["hosts"] if h.get("roofline")]
+    if rl_hosts:
+        out.append("")
+        out.append("roofline (predicted vs achieved, from the explain "
+                   "snapshot):")
+        for h in rl_hosts:
+            r = h["roofline"]
+            pct = (f"{r['pct_of_roofline']:.1f}% of roofline"
+                   if r.get("pct_of_roofline") else "no measured steps")
+            out.append(f"  {h['name']:<24}predicted "
+                       f"{r['predicted_ms']:.2f} ms "
+                       f"({r.get('bound')}-bound) — {pct}")
     if report["storms"]:
         out.append("")
         out.append(f"recompile storms: {', '.join(report['storms'])}")

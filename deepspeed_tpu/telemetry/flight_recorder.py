@@ -215,6 +215,15 @@ class FlightRecorder:
                 doc["host_rss_bytes"] = rss
         except Exception:
             pass
+        try:
+            # predicted-vs-achieved: the last compile-time explain
+            # snapshot rides along so dstpu-doctor can name the roofline
+            # gap post mortem
+            from deepspeed_tpu.telemetry import explain
+            if explain.last_report:
+                doc["explain"] = dict(explain.last_report)
+        except Exception:
+            pass
         return doc
 
     def dump(self, path: Optional[str] = None,

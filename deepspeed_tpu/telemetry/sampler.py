@@ -26,10 +26,31 @@ PEAK_FLOPS_BF16: Dict[str, float] = {
     "v2": 45e12,
 }
 
+#: peak HBM bandwidth, bytes/s per chip (public TPU specs; the memory
+#: side of the roofline — see telemetry/explain.py)
+PEAK_HBM_BW: Dict[str, float] = {
+    "v6e": 1640e9, "trillium": 1640e9,
+    "v5p": 2765e9,
+    "v5e": 819e9, "v5 lite": 819e9, "v5litepod": 819e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
 
-def peak_flops(device: Any = None) -> float:
-    """Peak bf16 FLOPs/s for ``device`` (default: first jax device).
-    0.0 for CPU/unknown platforms — MFU is not meaningful there."""
+#: HBM capacity, bytes per chip (public TPU specs; v2/v3 listed per core
+#: — jax exposes cores as devices there). Used as the budget ceiling when
+#: the backend doesn't report ``memory_stats()['bytes_limit']``.
+HBM_CAPACITY: Dict[str, float] = {
+    "v6e": 32 * 2**30, "trillium": 32 * 2**30,
+    "v5p": 95 * 2**30,
+    "v5e": 16 * 2**30, "v5 lite": 16 * 2**30, "v5litepod": 16 * 2**30,
+    "v4": 32 * 2**30,
+    "v3": 16 * 2**30,
+    "v2": 8 * 2**30,
+}
+
+
+def _lookup(table: Dict[str, float], device: Any) -> float:
     if device is None:
         try:
             import jax
@@ -37,10 +58,32 @@ def peak_flops(device: Any = None) -> float:
         except Exception:
             return 0.0
     kind = str(getattr(device, "device_kind", "cpu")).lower()
-    for key, val in PEAK_FLOPS_BF16.items():
+    for key, val in table.items():
         if key in kind:
             return val
     return 0.0
+
+
+def peak_flops(device: Any = None) -> float:
+    """Peak bf16 FLOPs/s for ``device`` (default: first jax device).
+    0.0 for CPU/unknown platforms — MFU is not meaningful there."""
+    return _lookup(PEAK_FLOPS_BF16, device)
+
+
+def peak_hbm_bw(device: Any = None) -> float:
+    """Peak HBM bytes/s for ``device`` (default: first jax device).
+    0.0 for CPU/unknown platforms."""
+    return _lookup(PEAK_HBM_BW, device)
+
+
+def hbm_capacity(device: Any = None) -> float:
+    """Per-device HBM bytes: the backend's ``bytes_limit`` when reported
+    (the allocator's real ceiling), else the spec-sheet table, else 0.0
+    (CPU/unknown — no budget ceiling to check against)."""
+    stats = device_memory_stats(device)
+    if stats and stats.get("bytes_limit"):
+        return float(stats["bytes_limit"])
+    return _lookup(HBM_CAPACITY, device)
 
 
 def mfu(flops: float, seconds: float, n_devices: int = 1,

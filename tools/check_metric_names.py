@@ -9,7 +9,10 @@ Rules (docs/observability.md "metric catalog"):
 - names are ``area/name`` — at least two ``/``-separated segments;
 - segments are lowercase ``[a-z0-9_]`` (f-string ``{placeholder}``
   segments are allowed and normalized to ``{}``);
-- one name ↔ one metric type across the whole tree.
+- one name ↔ one metric type across the whole tree;
+- the leading area segment must come from ``KNOWN_AREAS`` (the catalog's
+  table of contents) — a typo'd area (``rooflne/``) otherwise publishes
+  silently into a namespace no dashboard watches.
 
 Only literal string / f-string first arguments are checked; call sites
 passing a variable (e.g. ``gauge(name)`` in a generic flusher) are
@@ -28,6 +31,11 @@ from typing import Dict, List, Optional, Set, Tuple
 
 METRIC_METHODS = ("counter", "gauge", "histogram")
 _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
+
+#: the metric catalog's areas (docs/observability.md) — extend here AND
+#: in the docs when a new subsystem starts publishing
+KNOWN_AREAS = ("anomaly", "comm", "compile", "mem", "roofline",
+               "serving", "train")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
@@ -90,6 +98,12 @@ def check(sites) -> List[str]:
             errors.append(f"{path}:{line}: metric {name!r} has invalid "
                           f"segment(s) {bad} (want lowercase "
                           f"[a-z0-9_] or a placeholder)")
+        elif len(segments) >= 2 and segments[0] not in KNOWN_AREAS \
+                and segments[0] != "{}":
+            errors.append(f"{path}:{line}: metric {name!r} uses unknown "
+                          f"area {segments[0]!r} (known: "
+                          f"{', '.join(KNOWN_AREAS)}; extend KNOWN_AREAS "
+                          f"+ the docs catalog for a new subsystem)")
         types_by_name.setdefault(name, set()).add(mtype)
         first_site.setdefault(name, (path, line, mtype))
         if len(types_by_name[name]) > 1:
