@@ -205,6 +205,128 @@ def moe_main(args) -> None:
         tracer.dump(args.trace)
 
 
+def overlap_main(args) -> None:
+    """A/B the chunked overlap-scheduled ZeRO-3 collectives against the
+    monolithic stage-3 path: identical model, mesh, rng and data; one
+    JSON line with per-mode step time, loss, roofline stamp and the
+    ``overlap/*`` plan numbers (chunks, prefetch, transient HBM,
+    achieved overlap fraction). On a CPU host the mesh is forced to 8
+    virtual devices (the dp=8 smoke geometry the tier-1 tests use);
+    wall-clock there validates ordering/numerics — the latency-hiding
+    win itself only shows on TPU backends with the scheduler flags."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" and \
+            "host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    dev0 = jax.devices()[0]
+    on_tpu = dev0.platform == "tpu"
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(json.dumps({"metric": "zero3 overlap A/B", "value": 0.0,
+                          "error": f"needs a dp>=2 mesh, got {n_dev} "
+                                   "device(s) (CPU: JAX_PLATFORMS=cpu)"}))
+        return
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.runtime.zero.overlap import overlap_fraction
+
+    size = args.size or ("1b" if on_tpu else "tiny")
+    seq = args.seq or (2048 if on_tpu else 128)
+    batch = args.batch or 8
+    steps = args.steps or (24 if on_tpu else 4)
+    warmup = 3 if on_tpu else 1
+    model = llama3_config(size, max_seq_len=seq, tie_embeddings=True)
+    chunk_knobs = {
+        "overlap_comm": True,
+        "overlap_bucket_bytes": int(os.environ.get(
+            "DSTPU_BENCH_OVERLAP_BUCKET", 0)),
+        "overlap_prefetch": int(os.environ.get(
+            "DSTPU_BENCH_OVERLAP_PREFETCH", 1)),
+        "overlap_regather": os.environ.get(
+            "DSTPU_BENCH_OVERLAP_REGATHER", "1") != "0",
+    }
+
+    def run(zero_extra):
+        ds.build_mesh(data=n_dev)
+        config = {
+            "train_micro_batch_size_per_gpu": max(1, batch // n_dev),
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 3, **zero_extra},
+            "bf16": {"enabled": bool(on_tpu)},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 1000,
+        }
+        engine, *_ = ds.initialize(model=model, config=config,
+                                   rng=jax.random.PRNGKey(0))
+        gb = int(engine.config.train_batch_size)
+        rng = np.random.default_rng(0)
+        batches = [jax.device_put({"input_ids": rng.integers(
+            0, model.vocab_size, size=(gb, seq), dtype=np.int32)})
+            for _ in range(4)]
+        for i in range(warmup):
+            float(engine.train_batch(iter([batches[i % 4]])))
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            loss = engine.train_batch(iter([batches[i % 4]]))
+        loss_val = float(loss)
+        dt = time.perf_counter() - t0
+        rec = {"step_ms": round(dt / steps * 1e3, 3),
+               "loss": loss_val}
+        try:
+            from deepspeed_tpu.telemetry import explain as _explain
+            rep = _explain.explain_engine(
+                engine, measured_step_ms=dt / steps * 1e3)
+            rl = rep.roofline
+            rec["roofline"] = {
+                "flops_per_step": rl.flops, "bytes_per_step": rl.bytes,
+                "comm_bytes_per_step": rl.comm_bytes,
+                "predicted_step_ms": round(rl.predicted_s * 1e3, 3),
+                "bound": rl.bound,
+                "pct_of_roofline": round(rl.pct_of(dt / steps) or 0.0, 2),
+            }
+            plan = getattr(engine, "_overlap_plan", None)
+            if plan is not None:
+                frac = overlap_fraction(rl.compute_s, rl.comm_s, dt / steps)
+                rec["overlap"] = {
+                    "chunks": plan.n_chunks,
+                    "prefetch": plan.prefetch,
+                    "regather": plan.regather,
+                    "bucket_bytes": plan.bucket_bytes,
+                    "transient_hbm_bytes": int(plan.transient_bytes()),
+                    "fraction": (round(frac, 4)
+                                 if frac is not None else None),
+                }
+        except Exception:
+            pass
+        return rec
+
+    mono = run({"overlap_comm": False})
+    chunked = run(chunk_knobs)
+    speedup = (mono["step_ms"] / chunked["step_ms"]
+               if chunked["step_ms"] else 0.0)
+    result = {
+        "metric": f"zero3 overlap A/B llama3-{size} seq{seq} dp{n_dev} "
+                  f"{dev0.platform}",
+        "value": round(speedup, 4),
+        "unit": "x step-time vs monolithic",
+        "extra": {
+            "monolithic": mono, "chunked": chunked,
+            "loss_abs_diff": abs(mono["loss"] - chunked["loss"]),
+            "platform": dev0.platform, "n_devices": n_dev,
+            "steps": steps, "seq": seq,
+        },
+    }
+    print(json.dumps(result))
+    if getattr(args, "trace", None):
+        from deepspeed_tpu.telemetry import tracer
+        tracer.dump(args.trace)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default=None,
@@ -213,6 +335,11 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--mode", default="dense", choices=("dense", "moe"))
+    ap.add_argument("--overlap", action="store_true",
+                    help="A/B the chunked overlap-scheduled ZeRO-3 "
+                         "collectives vs the monolithic stage-3 path "
+                         "(knobs: DSTPU_BENCH_OVERLAP_BUCKET/_PREFETCH/"
+                         "_REGATHER)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="record host-side spans and dump Chrome trace-event"
                          " JSON here (inspect with bin/dstpu-trace or "
@@ -222,6 +349,9 @@ def main() -> None:
     if args.trace:
         from deepspeed_tpu.telemetry import tracer
         tracer.configure(enabled=True)
+    if args.overlap:
+        overlap_main(args)
+        return
     if args.mode == "moe":
         moe_main(args)
         return

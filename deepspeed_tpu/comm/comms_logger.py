@@ -106,6 +106,39 @@ class CommsLogger:
             logger.info("comm op: %s | size: %s | axis: %s", op_name,
                         convert_size(size_bytes), axis)
 
+    def append_chunked(self, op_name: str, size_bytes: int, axis: Any = None,
+                       chunks: int = 1) -> None:
+        """Record ``chunks`` same-sized collective calls in one go (the
+        ZeRO-3 chunked-overlap path issues dozens of small per-chunk
+        collectives per step — one record per chunk would flood the
+        tracer ring and the log). Accounting stays EXACT: comms_dict
+        counts every chunk and the byte counters accrue
+        ``chunks × size_bytes`` (flight-recorder comm-bytes deltas are
+        computed from these counters). At default verbosity the tracer
+        gets ONE coalesced instant carrying the chunk count; under
+        ``verbose`` the raw per-chunk instants + log lines come back."""
+        if chunks <= 1:
+            return self.append(op_name, size_bytes, axis)
+        if not self.should_log(op_name):
+            return
+        rec = self.comms_dict[op_name][size_bytes]
+        rec[0] += chunks
+        from deepspeed_tpu.telemetry import registry, tracer
+        registry.counter("comm/bytes",
+                         help="bytes entering collectives (trace-time)"
+                         ).inc(max(0, size_bytes) * chunks)
+        registry.counter(f"comm/{op_name}/calls").inc(chunks)
+        ax = str(axis) if axis is not None else None
+        if self.verbose:
+            for _ in range(chunks):
+                tracer.instant(f"comm/{op_name}", bytes=size_bytes, axis=ax)
+            logger.info("comm op: %s | size: %s | axis: %s | x%d chunks",
+                        op_name, convert_size(size_bytes), axis, chunks)
+        else:
+            tracer.instant(f"comm/{op_name}", bytes=size_bytes * chunks,
+                           axis=ax, chunks=chunks,
+                           chunk_bytes=size_bytes)
+
     def reset(self) -> None:
         self.comms_dict.clear()
 

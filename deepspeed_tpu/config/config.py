@@ -212,7 +212,26 @@ class ZeroConfig(TPUConfigModel):
     reduce_bucket_size: Union[int, str] = 500_000_000
     allgather_partitions: bool = True
     allgather_bucket_size: Union[int, str] = 500_000_000
-    overlap_comm: Optional[bool] = None   # XLA overlaps automatically; kept for parity
+    #: stage 3 only: chunk the per-layer param all-gathers / grad
+    #: reduce-scatters and pipeline them against compute
+    #: (runtime/zero/overlap.py). None/False keeps the monolithic
+    #: whole-tree collectives (XLA still overlaps what it can).
+    overlap_comm: Optional[bool] = None
+    #: layer-bucket size (global param bytes) for the chunked overlap
+    #: path; 0 = one chunk per layer (finest pipelining)
+    overlap_bucket_bytes: int = 0
+    #: chunks gathered ahead of the one computing (>=0); higher hides
+    #: more latency at the cost of transient HBM (prefetch+1 gathered
+    #: chunks live at once — see overlap/transient_hbm_bytes)
+    overlap_prefetch: int = 1
+    #: true (default): the backward re-gathers each chunk, so gathered
+    #: weights never persist from forward to backward (transient HBM =
+    #: prefetch+1 chunks; comm doubles for param gathers). false: keep
+    #: gathered chunks as backward residuals — the reference's
+    #: stage3_max_reuse_distance reuse — saving the re-gather traffic at
+    #: the cost of the whole gathered stack living through the step (the
+    #: HBM budget accounts whichever is selected).
+    overlap_regather: bool = True
     offload_optimizer: OffloadOptimizerConfig = Field(default_factory=OffloadOptimizerConfig)
     offload_param: OffloadParamConfig = Field(default_factory=OffloadParamConfig)
     #: ZenFlow (reference zero/config.py:171): presence enables it; needs
@@ -243,6 +262,18 @@ class ZeroConfig(TPUConfigModel):
     def _validate_stage(self) -> "ZeroConfig":
         if self.stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.overlap_bucket_bytes < 0:
+            raise ValueError("zero_optimization.overlap_bucket_bytes must be >= 0")
+        if self.overlap_prefetch < 0:
+            raise ValueError("zero_optimization.overlap_prefetch must be >= 0")
+        if self.overlap_comm and self.stage != 3:
+            # ported DeepSpeed configs routinely carry overlap_comm at
+            # stage 1/2, where the reference overlaps on a side stream;
+            # here there is no param gather to chunk below stage 3
+            logger.warning(
+                "zero_optimization.overlap_comm is a stage-3 knob here "
+                f"(chunked param gathers); ignored at stage {self.stage}")
+            self.overlap_comm = False
         return self
 
 

@@ -811,13 +811,20 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
                    positions: Optional[jax.Array] = None,
                    remat_policy: Optional[str] = None,
                    token_type_ids: Optional[jax.Array] = None,
-                   attention_mask: Optional[jax.Array] = None
+                   attention_mask: Optional[jax.Array] = None,
+                   layer_loop: Optional[Callable] = None
                    ) -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, T] int32 → (final-norm hidden [B, T, D], MoE aux loss).
 
     Layers applied with ``lax.scan`` over the stacked pytree; optional
     ``jax.checkpoint`` per block (the reference's activation checkpointing
     runtime/activation_checkpointing/ → remat on TPU).
+
+    ``layer_loop``: optional replacement for the plain
+    ``lax.scan(body, x, xs)`` with the same contract (carry in, carry +
+    stacked-aux out) — the ZeRO-3 chunked-overlap path
+    (runtime/zero/overlap.py OverlapPlan.layer_loop) injects its
+    gather/compute pipeline here without this module importing runtime.
 
     ``attention_mask``: [B, T] (1 = real, 0 = pad; HF convention). Only
     needed for ENCODERS, where pad keys attend into every position;
@@ -860,7 +867,10 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
     if remat_policy and remat_policy != "none":
         body = jax.checkpoint(body, policy=resolve_remat_policy(remat_policy))
 
-    x, aux = lax.scan(body, x, scan_xs)
+    if layer_loop is not None:
+        x, aux = layer_loop(body, x, scan_xs)
+    else:
+        x, aux = lax.scan(body, x, scan_xs)
     if cfg.has_final_norm:
         x = _norm(cfg, params["final_norm"], x)
     return x, jnp.sum(aux)

@@ -96,6 +96,13 @@ class ModelSpec:
     #: lets the hybrid engine spin up an inference engine over the same
     #: params (reference runtime/hybrid_engine.py)
     decoder_config: Optional[Any] = None
+    #: ZeRO-3 chunked-overlap hook: (mesh, abstract_params) ->
+    #: Optional[OverlapPlan]. Set by the factory when
+    #: zero_optimization.overlap_comm is on; the engine calls it from the
+    #: standard fused-step path once mesh + abstract params exist, and
+    #: the factory arms loss_fn with the returned plan's layer_loop
+    #: (runtime/zero/overlap.py)
+    configure_overlap: Optional[Callable[..., Any]] = None
 
 
 @dataclass
@@ -431,6 +438,10 @@ class DeepSpeedTPUEngine:
 
     def _build_step_functions(self) -> None:
         gas = int(self.config.gradient_accumulation_steps)
+        #: ZeRO-3 chunked-overlap plan; stays None on every path that
+        #: doesn't run the standard fused step (zeropp/onebit/offload/
+        #: pipeline fall through to monolithic collectives)
+        self._overlap_plan = None
 
         if getattr(self, "_zeropp_enabled", False):
             from deepspeed_tpu.runtime.zero.zeropp import build_zeropp_step
@@ -533,6 +544,18 @@ class DeepSpeedTPUEngine:
             self._update_step = None
             self._rng = jax.random.PRNGKey(self.config.seed + 1)
             return
+
+        if self.model.configure_overlap is not None:
+            # arm the chunked ZeRO-3 collective pipeline BEFORE tracing:
+            # the hook stores the plan in the factory's loss_fn closure,
+            # so every step function traced below picks up the chunked
+            # layer loop (runtime/zero/overlap.py)
+            self._overlap_plan = self.model.configure_overlap(
+                self.mesh, self._abstract_params)
+            if self._overlap_plan is not None:
+                from deepspeed_tpu.runtime.zero import overlap as _overlap
+                _overlap.verify_scheduler_flags()
+                self._overlap_plan.publish_static_gauges()
 
         # fused train_batch step: batch leaves have leading [gas, ...] dim
         def fused_step(params, opt_state, scaler, batch, step, rng):
@@ -1017,6 +1040,11 @@ class DeepSpeedTPUEngine:
     def _init_telemetry(self) -> None:
         tcfg = self.config.telemetry
         telemetry.configure(tcfg)   # enable-only; never silences the tracer
+        # arm the trace-time collective recorder from its config block
+        # (jit is lazy — the step traces on the first train_batch, after
+        # this runs)
+        from deepspeed_tpu.comm.comms_logger import comms_logger
+        comms_logger.configure(self.config)
         if tcfg.enabled and tcfg.trace_file:
             import atexit
             atexit.register(telemetry.tracer.dump, tcfg.trace_file)
@@ -1051,6 +1079,10 @@ class DeepSpeedTPUEngine:
         # logged (pure metadata, no compile); the full roofline explain —
         # one extra XLA compile of the step — is opt-in
         self._roofline_predicted_s = 0.0
+        # roofline terms kept for the overlap-fraction gauge: achieved
+        # compute/comm overlap needs modeled compute_s and comm_s
+        self._roofline_compute_s = 0.0
+        self._roofline_comm_s = 0.0
         from deepspeed_tpu.telemetry import explain as _explain
         try:
             _explain.startup_budget(self)
@@ -1061,6 +1093,8 @@ class DeepSpeedTPUEngine:
                 report = _explain.explain_engine(self)
                 _explain.publish_gauges(report)
                 self._roofline_predicted_s = report.roofline.predicted_s
+                self._roofline_compute_s = report.roofline.compute_s
+                self._roofline_comm_s = report.roofline.comm_s
                 log_dist("\n" + _explain.render(report))
             except Exception as e:                   # noqa: BLE001
                 logger.warning(f"explain_startup failed (non-fatal): {e}")
@@ -1109,6 +1143,17 @@ class DeepSpeedTPUEngine:
                     "roofline/pct",
                     help="predicted/measured step time, percent"
                 ).set(100.0 * self._roofline_predicted_s / dt_s)
+            if getattr(self, "_overlap_plan", None) is not None:
+                from deepspeed_tpu.runtime.zero.overlap import (
+                    overlap_fraction)
+                frac = overlap_fraction(self._roofline_compute_s,
+                                        self._roofline_comm_s, dt_s)
+                if frac is not None:
+                    reg.gauge(
+                        "overlap/fraction",
+                        help="achieved compute/comm overlap, 0-1 "
+                             "(hidden share of min(compute_s, comm_s))"
+                    ).set(frac)
         if self._mem_sampler is not None and \
                 self.global_steps % max(1, self.config.steps_per_print) == 0:
             self._mem_sampler.sample()

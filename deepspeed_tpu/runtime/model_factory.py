@@ -299,6 +299,11 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             return moe_fn(c, p, x, rts_key=lk)
         return mf
 
+    # ZeRO-3 chunked-overlap plan, filled in by the engine (which owns
+    # the mesh + abstract params) via ModelSpec.configure_overlap; while
+    # unset, loss_fn runs the plain monolithic layer scan
+    _ovl = {"plan": None}
+
     def loss_fn(params, batch, rng):
         tokens = batch["input_ids"]
         if "labels" in batch:
@@ -316,9 +321,12 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                 enc["attention_mask"] = batch["attention_mask"]
             if "token_type_ids" in batch:
                 enc["token_type_ids"] = batch["token_type_ids"]
+        plan = _ovl["plan"]
         hidden, aux = transformer.forward_hidden(
             dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
-            remat_policy=remat, **enc)
+            remat_policy=remat,
+            layer_loop=plan.layer_loop if plan is not None else None,
+            **enc)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
                                                  labels,
                                                  budget_bytes=ce_budget,
@@ -451,6 +459,22 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                 f"pipeline.schedule must be '1f1b' or 'gpipe', got "
                 f"'{ds_cfg.pipeline.schedule}'")
 
+    configure_overlap = None
+    zcfg = ds_cfg.zero_optimization
+    if zcfg.overlap_comm and zcfg.stage == 3 and stages <= 1:
+        def configure_overlap(mesh, abstract_params):
+            """Engine hook: build the chunked-overlap plan once mesh and
+            abstract params exist, and arm loss_fn with it. Returns the
+            plan (or None when the mesh can't run the chunked path)."""
+            from deepspeed_tpu.runtime.zero.overlap import build_overlap_plan
+            plan = build_overlap_plan(
+                mesh, specs["layers"], abstract_params["layers"], zcfg,
+                num_experts=dec_cfg.num_experts or 0)
+            _ovl["plan"] = plan
+            if plan is not None:
+                logger.info(plan.describe())
+            return plan
+
     n = dec_cfg.num_params()
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn,
                      partition_specs=specs,
@@ -458,4 +482,5 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
                      tokens_per_sample=dec_cfg.max_seq_len,
                      pipeline_loss_fn=pipeline_loss_fn,
                      pipeline_grad_fn=pipeline_grad_fn,
-                     decoder_config=dec_cfg)
+                     decoder_config=dec_cfg,
+                     configure_overlap=configure_overlap)

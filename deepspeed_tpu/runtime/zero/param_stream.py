@@ -254,7 +254,14 @@ class ParamStreamCoordinator:
         self._j_embed_vjp = jax.jit(embed_vjp)
 
     # ------------------------------------------------------------- layer IO
-    def _fetch_layer(self, l: int) -> Pytree:
+    def _issue_layer(self, l: int) -> Tuple[int, List[np.ndarray]]:
+        """Submit layer ``l``'s file reads WITHOUT waiting — the aio
+        engine copies in the background while the device computes the
+        previous layer (the software-pipelined prefetch the reference
+        swapper gets from its side-stream fetch hooks). Pair with
+        :meth:`_complete_layer`; the aio drain is a global barrier, so
+        never leave an issued layer pending across the optimizer sweep
+        (it rewrites params.bin under the reads)."""
         chunks = []
         import ml_dtypes
         np_dt = ml_dtypes.bfloat16 if self._p_item == 2 else np.float32
@@ -262,12 +269,20 @@ class ParamStreamCoordinator:
             buf = np.empty(n, np_dt)
             self.params_store.read(buf.view(np.uint8).view(np_dt), off)
             chunks.append(buf)
+        return l, chunks
+
+    def _complete_layer(self, issued: Tuple[int, List[np.ndarray]]
+                        ) -> Pytree:
+        _l, chunks = issued
         self.params_store.drain()
         tree = jax.tree.map(jnp.asarray,
                             self.lr_ranges.unflatten_layer(chunks))
         if self._repl_sharding is not None:
             tree = jax.device_put(tree, self._repl_sharding)
         return tree
+
+    def _fetch_layer(self, l: int) -> Pytree:
+        return self._complete_layer(self._issue_layer(l))
 
     def _write_layer_grads(self, l: int, dlp: Pytree,
                            accumulate: bool = False,
@@ -346,13 +361,20 @@ class ParamStreamCoordinator:
         for m in range(gas):
             tokens, labels = self._micro_tokens_labels(batch, m)
             last = m == gas - 1
-            # forward: stream layers, stash inputs
+            # forward: stream layers, stash inputs. Layer l+1's reads
+            # are ISSUED right after layer l's compute dispatches, so the
+            # file IO overlaps device time instead of serializing with it
+            # (one layer of lookahead — peak host memory stays at two
+            # layers of buffers); the final forward issue targets L-1,
+            # prefetching the first backward layer under the head vjp.
             x = self._j_embed(self.resident, tokens)
             stash = [x]
+            pending = self._issue_layer(0)
             for l in range(L):
-                lp = self._fetch_layer(l)
+                lp = self._complete_layer(pending)
                 x = self._j_layer(lp, x, tokens)
                 stash.append(x)
+                pending = self._issue_layer(l + 1 if l + 1 < L else L - 1)
 
             loss, dx, dres_head = self._j_head_vjp(
                 self.resident, stash[-1], labels, seed)
@@ -361,10 +383,15 @@ class ParamStreamCoordinator:
             # vjp; microbatches past the first ACCUMULATE into grads.bin
             # (read-modify-write — the reference swapper's grad partition
             # pass); the norm is computed from the last micro's final
-            # values only
+            # values only. Layer l-1's reads are issued before layer l's
+            # grads are written out, overlapping IO with the D2H + write
+            # path; nothing stays pending after l=0 (the optimizer sweep
+            # rewrites params.bin next).
             for l in reversed(range(L)):
-                lp = self._fetch_layer(l)
+                lp = self._complete_layer(pending)
                 dx, dlp = self._j_layer_vjp(lp, stash[l], tokens, dx)
+                if l > 0:
+                    pending = self._issue_layer(l - 1)
                 ssq_l = self._write_layer_grads(l, dlp, accumulate=m > 0,
                                                 want_ssq=last)
                 if last:
@@ -393,9 +420,14 @@ class ParamStreamCoordinator:
         """Forward-only streamed loss (evaluation for models whose params
         don't fit HBM — same layer streaming as training, no stash/vjp)."""
         tokens, labels = self._micro_tokens_labels(batch, 0)
+        L = self.lr_ranges.num_layers
         x = self._j_embed(self.resident, tokens)
-        for l in range(self.lr_ranges.num_layers):
-            x = self._j_layer(self._fetch_layer(l), x, tokens)
+        pending = self._issue_layer(0)
+        for l in range(L):
+            lp = self._complete_layer(pending)
+            x = self._j_layer(lp, x, tokens)
+            if l + 1 < L:
+                pending = self._issue_layer(l + 1)
         return self._j_head_loss(self.resident, x, labels)
 
     # ---------------------------------------------------------------- update

@@ -116,12 +116,24 @@ def normalize_cost_analysis(cost: Any) -> Dict[str, float]:
             if isinstance(v, (int, float)) and math.isfinite(float(v))}
 
 
-def collective_bytes_from_hlo(hlo_text: str) -> float:
-    """Bytes produced by collective ops in optimized HLO text (the
-    output shape of each all-reduce / all-gather / reduce-scatter /
-    all-to-all / collective-permute instruction). An approximation of
-    wire traffic — good enough to rank the comm roofline bound."""
-    total = 0.0
+def collective_stats_from_hlo(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Per-op collective traffic in optimized HLO text:
+    ``{op: {"bytes": float, "count": int}}``.
+
+    Counts each collective INSTRUCTION once. Async pairs are attributed
+    to the ``-start`` op only (the ``-done`` merely unpacks the result),
+    and a ``-start`` whose shape is a tuple — ``(operand_aliases,
+    result)`` or the tupled variadic form — contributes the single
+    LARGEST element of the tuple, not the sum: summing would double-count
+    every async/fused collective (all-gather-start's tuple repeats the
+    operand next to the gathered result; all-reduce-start's repeats the
+    buffer on both sides; collective-permute-start adds tiny u32 context
+    slots). The chunked ZeRO-3 overlap path fragments the whole-model
+    gather into dozens of small async all-gathers, which made that
+    double-count structural rather than occasional — ``count`` exposes
+    the fragmentation (chunk count) instead.
+    """
+    stats: Dict[str, Dict[str, float]] = {}
     for line in hlo_text.splitlines():
         m = _INSTR_RE.search(line)
         if m is None:
@@ -133,6 +145,7 @@ def collective_bytes_from_hlo(hlo_text: str) -> float:
             op = op[:-len("-start")]
         if op not in _COLLECTIVE_OPS:
             continue
+        best = 0.0
         for dt, dims in _SHAPE_RE.findall(m.group("shape")):
             nbytes = _DTYPE_BYTES.get(dt)
             if nbytes is None:
@@ -141,8 +154,21 @@ def collective_bytes_from_hlo(hlo_text: str) -> float:
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            total += n * nbytes
-    return total
+            best = max(best, float(n * nbytes))
+        s = stats.setdefault(op, {"bytes": 0.0, "count": 0})
+        s["bytes"] += best
+        s["count"] += 1
+    return stats
+
+
+def collective_bytes_from_hlo(hlo_text: str) -> float:
+    """Total bytes moved by collectives in optimized HLO text (the
+    largest buffer of each collective instruction, summed). An
+    approximation of wire traffic — good enough to rank the comm
+    roofline bound. See :func:`collective_stats_from_hlo` for the
+    per-op/per-chunk breakdown."""
+    return sum(s["bytes"] for s in collective_stats_from_hlo(
+        hlo_text).values())
 
 
 @dataclass
@@ -158,6 +184,9 @@ class FunctionCost:
     temp_bytes: float = 0.0
     generated_code_bytes: float = 0.0
     collective_bytes: float = 0.0
+    #: {op: {"bytes", "count"}} — per-op totals + instruction counts
+    #: (chunked-overlap runs show count ≈ 2×chunks here)
+    collective_stats: Dict[str, Dict[str, float]] = None
     error: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
@@ -185,7 +214,9 @@ def analyze_compiled(name: str, compiled) -> FunctionCost:
     except Exception:
         pass
     try:
-        fc.collective_bytes = collective_bytes_from_hlo(compiled.as_text())
+        fc.collective_stats = collective_stats_from_hlo(compiled.as_text())
+        fc.collective_bytes = sum(s["bytes"]
+                                  for s in fc.collective_stats.values())
     except Exception:
         pass
     return fc
@@ -465,6 +496,13 @@ def render(report: ExplainReport) -> str:
             f"{_fmt_bytes(f.argument_bytes):>12}"
             f"{_fmt_bytes(f.temp_bytes):>12}"
             f"{_fmt_bytes(f.collective_bytes):>12}{note}")
+        if f.collective_stats:
+            # per-op breakdown with instruction counts — under the
+            # chunked-overlap path the count is the chunk fan-out
+            parts = ", ".join(
+                f"{op} {_fmt_bytes(s['bytes'])} in {int(s['count'])} op(s)"
+                for op, s in sorted(f.collective_stats.items()))
+            out.append(f"  {'':<22}collectives: {parts}")
     if report.params:
         out.append("")
         top = sorted(report.params, key=lambda r: -r[3])[:12]
@@ -575,7 +613,10 @@ def _shard_bytes(tree) -> float:
 
 def static_budget(engine) -> Dict[str, float]:
     """The compile-free part of the HBM budget (bytes per device):
-    params / optimizer state / loss-scale shard sizes. Pure metadata —
+    params / optimizer state / loss-scale shard sizes, plus — when the
+    chunked ZeRO-3 overlap path is armed — the transient footprint of
+    in-flight gathered chunks (prefetch+1 chunks live at once; they are
+    freed after use but the budget must cover the peak). Pure metadata —
     never syncs the device."""
     budget: Dict[str, float] = {}
     params = getattr(engine, "params", None)
@@ -587,6 +628,12 @@ def static_budget(engine) -> Dict[str, float]:
     scaler = getattr(engine, "loss_scale_state", None)
     if scaler is not None:
         budget["loss_scale_state"] = _shard_bytes(scaler)
+    plan = getattr(engine, "_overlap_plan", None)
+    if plan is not None:
+        try:
+            budget["overlap_gathered_chunks"] = float(plan.transient_bytes())
+        except Exception:
+            pass
     return budget
 
 

@@ -150,13 +150,15 @@ def test_collective_bytes_from_hlo():
         "  ag = bf16[16,64]{1,0} all-gather(p0), dimensions={0}",
         "  cp = f32[4]{0} collective-permute(p0)",
         "  add = f32[8,64]{1,0} add(p0, p0)",   # not a collective
-        # async pair: count the start (tuple shape), never the done
+        # async pair: count the start (tuple shape → LARGEST element
+        # only, the operand alias next to it must not double-count),
+        # never the done
         "  rs = (f32[8]{0}, f32[2]{0}) reduce-scatter-start(p0)",
         "  rsd = f32[2]{0} reduce-scatter-done(rs)",
         "}",
     ])
     got = collective_bytes_from_hlo(hlo)
-    want = 8 * 64 * 4 + 16 * 64 * 2 + 4 * 4 + (8 + 2) * 4
+    want = 8 * 64 * 4 + 16 * 64 * 2 + 4 * 4 + 8 * 4
     assert got == pytest.approx(want)
     assert collective_bytes_from_hlo("") == 0.0
 

@@ -34,7 +34,7 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
-KNOWN_AREAS = ("anomaly", "comm", "compile", "mem", "roofline",
+KNOWN_AREAS = ("anomaly", "comm", "compile", "mem", "overlap", "roofline",
                "serving", "train")
 
 
