@@ -150,6 +150,13 @@ def main() -> None:
                          "shared_prefix_stream: serving frontend with "
                          "the radix prefix cache on vs off over "
                          "50%%-shared prompts")
+    ap.add_argument("--megastep", nargs="?", const=32, type=int,
+                    default=None, metavar="K",
+                    help="A/B the serving frontend stepwise vs decode "
+                         "megasteps of up to K tokens (bare flag = 32) "
+                         "on the stream workload, stamping per-mode "
+                         "tok/s and host-dispatch calls per token "
+                         "(dispatch/host_calls deltas) into the JSON")
     args = ap.parse_args()
 
     if args.scenario == "shared_prefix_stream":
@@ -280,6 +287,53 @@ def main() -> None:
                                                   max_new_tokens=uni))
                        for _ in range(2))
 
+    # ---- optional --megastep A/B: the SAME long-tail stream through the
+    # serving frontend, stepwise (K=1, 2+ host round-trips per token) vs
+    # decode megasteps (up to K tokens per device program). The headline
+    # is host-dispatch calls per generated token — the dispatch/
+    # host_calls counter increments once per device launch, so the
+    # megastep column should land near 1/K of stepwise on decode-heavy
+    # stretches
+    megastep_extra = None
+    if args.megastep:
+        from deepspeed_tpu.serving import ServingFrontend
+        from deepspeed_tpu.telemetry.registry import registry
+
+        def run_frontend(k):
+            fe = ServingFrontend(v2, max_queue=n_req,
+                                 enable_prefix_cache=False,
+                                 megastep_tokens=k,
+                                 megastep_adaptive=False)
+            for p, m in zip(prompts, budgets):
+                fe.submit([int(t) for t in p], max_new_tokens=int(m))
+            fe.run_until_idle()
+            return fe
+
+        def measure(k):
+            run_frontend(k)                       # compile this K's buckets
+            hc0 = registry.counter("dispatch/host_calls").value
+            t0 = time.perf_counter()
+            fe = run_frontend(k)
+            wall = time.perf_counter() - t0
+            calls = registry.counter("dispatch/host_calls").value - hc0
+            toks = fe.metrics.counters["tokens_out"]
+            return {"tok_s": round(toks / wall, 2),
+                    "host_calls": int(calls),
+                    "host_calls_per_token": round(calls / max(1, toks), 4),
+                    "tokens": int(toks), "wall_s": round(wall, 3)}
+
+        stepwise = measure(0)
+        mega = measure(int(args.megastep))
+        megastep_extra = {
+            "k": int(args.megastep),
+            "stepwise": stepwise,
+            "megastep": mega,
+            "dispatch_reduction": round(
+                stepwise["host_calls_per_token"] /
+                max(1e-9, mega["host_calls_per_token"]), 2),
+            "speedup": round(stepwise["wall_s"] / mega["wall_s"], 3),
+        }
+
     gen_tokens = int(sum(new_list))
     uni_tokens = conc * uni
     result = {
@@ -309,6 +363,8 @@ def main() -> None:
             "roofline": _roofline_extra(v2),
         },
     }
+    if megastep_extra is not None:
+        result["extra"]["megastep"] = megastep_extra
     print(json.dumps(result))
 
 

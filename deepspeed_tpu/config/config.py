@@ -428,6 +428,25 @@ class TelemetryConfig(TPUConfigModel):
     peak_hbm_bw_override: Optional[float] = Field(default=None, gt=0)
 
 
+class ServingConfig(TPUConfigModel):
+    """``"serving"`` block → deepspeed_tpu/serving (ServingFrontend).
+
+    Decode megasteps: when the SplitFuse selection is decode-only, the
+    frontend may run up to ``megastep_tokens`` single-token iterations in
+    ONE jitted device program (engine_v2 ``_try_megastep``) — the host
+    syncs once per window instead of 2+ round-trips per token. Megastep
+    boundaries are the admission/shed/cancel points, so bigger windows
+    trade TTFT responsiveness for dispatch amortization (docs/serving.md
+    "Decode megasteps")."""
+    #: max decode tokens per device-resident window (0/1 = stepwise;
+    #: ServingFrontend(megastep_tokens=...) overrides)
+    megastep_tokens: int = Field(default=0, ge=0)
+    #: shrink the window dynamically: pending admissions cap it at the
+    #: shallowest remaining budget, a shallow decode backlog and tight
+    #: deadlines (roofline-predicted decode step time) pull it toward 1
+    megastep_adaptive: bool = True
+
+
 class TensorBoardConfig(TPUConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -552,6 +571,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)

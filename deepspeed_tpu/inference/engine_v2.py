@@ -164,6 +164,13 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _dispatch_count(name: str, by: int = 1) -> None:
+    """Bump a ``dispatch/*`` counter (lazy import: telemetry pulls in the
+    whole diagnostics stack, which must not load at engine-import time)."""
+    from deepspeed_tpu.telemetry.registry import registry
+    registry.counter(name).inc(by)
+
+
 def _sample_tokens(logits, mode, temperature, top_p, rng):
     """Shared on-device sampling (mode is STATIC: ('argmax',) or
     ('sample', top_k, use_top_p); temperature/top_p are traced scalars so
@@ -504,7 +511,10 @@ class RaggedInferenceEngineTPU:
                 out[uid] = logits[i]
         return out
 
-    def step_with_budget(self, budget: Optional[int] = None, mode=("argmax",)
+    def step_with_budget(self, budget: Optional[int] = None,
+                         mode=("argmax",), max_steps: int = 1,
+                         row_limits: Optional[Dict[int, int]] = None,
+                         eos_ids: Optional[Dict[int, int]] = None
                          ) -> Optional[Dict[int, Any]]:
         """One engine step packing at most ``budget`` tokens (None → the
         scheduler's max_batch_tokens). The serving frontend's entry point:
@@ -512,16 +522,152 @@ class RaggedInferenceEngineTPU:
         prefill/decode mix, this just runs whatever it packed. Returns
         {uid: next_token_id} (or {uid: logits} with mode=None) for rows
         whose pending tokens were exhausted; None when idle.
+
+        ``max_steps > 1`` arms the decode MEGASTEP: when the scheduler's
+        selection comes back decode-only, up to ``max_steps`` single-token
+        iterations run in ONE device program (the host syncs once per K
+        tokens instead of once per token) and the return value becomes
+        ``{uid: [token, ...]}`` — a list per row, 1..K tokens, every one
+        of them already backed by KV in the arena except the last (which
+        the caller feeds back, exactly like the single-token contract).
+        ``row_limits`` caps the tokens a row may emit (its remaining
+        max_new_tokens budget); ``eos_ids`` maps uid → eos token id so a
+        row retires mid-megastep without burning its tail. Mixed
+        prefill/decode selections, ``mode=None`` (logits), and
+        ``max_steps == 1`` all take the unchanged stepwise path (with
+        lists still returned when ``max_steps > 1`` was requested, so
+        callers see ONE shape).
         """
         batch = self.scheduler.next_batch(budget=budget)
         if batch is None:
             return None
+        megastep = max_steps > 1 and mode is not None
+        if megastep:
+            out = self._try_megastep(batch, max_steps, mode, row_limits,
+                                     eos_ids)
+            if out is not None:
+                return out
         res = self._run(batch, mode=mode)
         self.scheduler.mark_scheduled(batch)
-        out: Dict[int, Any] = {}
+        out = {}
         for i, uid in enumerate(batch.uids):
             if self.state.seqs[uid].pending == 0:
-                out[uid] = res[i] if mode is None else int(res[i])
+                if mode is None:
+                    out[uid] = res[i]
+                else:
+                    out[uid] = [int(res[i])] if megastep else int(res[i])
+        return out
+
+    def _try_megastep(self, batch: RaggedBatch, k: int, mode,
+                      row_limits: Optional[Dict[int, int]],
+                      eos_ids: Optional[Dict[int, int]]
+                      ) -> Optional[Dict[int, List[int]]]:
+        """Run ``batch`` as one fused decode window of up to ``k`` tokens
+        per row; None → not applicable (caller falls through to the
+        stepwise path with the batch ALREADY selected — selecting twice
+        would double-advance the SplitFuse round-robin).
+
+        Applicable iff the selection is pure decode: every row is a
+        single-token chunk covering its whole pending queue. Serving
+        descriptors hold the fed token IN ``seq.tokens`` (the frontend
+        extends before scheduling), so starts/page math here differs from
+        ``_fused_decode``'s generate-path convention where the fed token
+        lives outside the descriptor.
+        """
+        n = len(batch.uids)
+        if n == 0 or batch.token_ids.shape[1] != 1:
+            return None
+        for i, uid in enumerate(batch.uids):
+            if int(batch.token_counts[i]) != 1 or \
+                    self.state.seqs[uid].pending != 1:
+                return None
+        # per-row window: requested k, clipped by the row's remaining
+        # token budget and by max_seq_len headroom (len(tokens) already
+        # counts the fed token, and a continuing row feeds one more)
+        lim: List[int] = []
+        for uid in batch.uids:
+            seq = self.state.seqs[uid]
+            r = k
+            if row_limits is not None and uid in row_limits:
+                r = min(r, int(row_limits[uid]))
+            r = min(r, self.config.max_seq_len - len(seq.tokens))
+            if r < 1:
+                return None
+            lim.append(r)
+        limit = max(lim)
+        if limit < 2:
+            return None              # degenerate megastep — stepwise wins
+        bs = self.state.allocator.block_size
+        need: List[int] = []
+        for uid, r in zip(batch.uids, lim):
+            seq = self.state.seqs[uid]
+            # KV high-water mark: seen_tokens rows exist, the window adds
+            # up to r more (fed token + r-1 continuation feeds)
+            need.append(-(-(seq.seen_tokens + r) // bs) - len(seq.blocks))
+        if sum(need) > self.state.allocator.free_blocks:
+            return None
+        for uid, c in zip(batch.uids, need):
+            if c > 0:
+                self.state.seqs[uid].blocks.extend(
+                    self.state.allocator.allocate(c))
+
+        nb = _bucket(n)
+        # pow2 scan buckets (not _FUSED_STEP_BUCKET multiples): the rng
+        # splits once per scan slot incl. dead ones, so aligned pow2
+        # windows keep sampled streams identical across K choices
+        sb = _bucket(limit)
+        tokens0 = np.zeros((nb,), np.int32)
+        starts0 = np.zeros((nb,), np.int32)
+        live = np.zeros((nb,), np.int32)
+        bud = np.zeros((nb,), np.int32)
+        eos = np.full((nb,), -1, np.int32)
+        for i, uid in enumerate(batch.uids):
+            seq = self.state.seqs[uid]
+            tokens0[i] = seq.tokens[-1]
+            starts0[i] = seq.seen_tokens
+            live[i] = 1
+            bud[i] = lim[i]
+            if eos_ids is not None and eos_ids.get(uid) is not None:
+                eos[i] = int(eos_ids[uid])
+        pt = self._page_table(batch.uids, nb)
+        mb_need = int(-(-(int(starts0.max()) + limit) // bs))
+        mb_b = min(self.mb, -(-mb_need // 4) * 4)
+        pt = pt[:, :mb_b]
+        from deepspeed_tpu import telemetry
+        with telemetry.tracer.span("serving/megastep", n=n, k=int(limit),
+                                   scan_bucket=sb):
+            ys, counts, self._rng_dev, self.arena = self._fused_decode_fn(
+                nb, sb, mode)(
+                    self.params, self.arena, jnp.asarray(tokens0),
+                    jnp.asarray(starts0), jnp.asarray(live),
+                    jnp.asarray(pt), jnp.int32(limit), jnp.asarray(bud),
+                    jnp.asarray(eos), jnp.float32(self._temperature),
+                    jnp.float32(self._top_p), self._rng_dev)
+            ys, counts = jax.device_get((ys, counts))   # ONE sync for K
+        ys = np.asarray(ys)
+        counts = np.asarray(counts)
+        _dispatch_count("dispatch/host_calls")
+        _dispatch_count("dispatch/scan_steps", sb)
+        _dispatch_count("dispatch/dead_steps", sb - limit)
+        _dispatch_count("dispatch/megastep_launches")
+        self.scheduler.mark_scheduled(batch)          # fed token consumed
+        out: Dict[int, List[int]] = {}
+        emitted_total = 0
+        for j, uid in enumerate(batch.uids):
+            c = int(counts[j])
+            emitted = [int(t) for t in ys[:c, j]]
+            emitted_total += c
+            seq = self.state.seqs[uid]
+            if c > 1:
+                # every emitted token except the LAST has its KV in the
+                # arena already; record them on the descriptor so
+                # seen == len(tokens) == KV rows. The last token follows
+                # the single-token contract: the caller decides whether
+                # to feed it back (state.extend) or retire the row.
+                seq.tokens.extend(emitted[:-1])
+                seq.seen_tokens = len(seq.tokens)
+            out[uid] = emitted
+        _dispatch_count("dispatch/megastep_tokens", emitted_total)
         return out
 
     def cow_block(self, src_block: int) -> int:
@@ -573,6 +719,7 @@ class RaggedInferenceEngineTPU:
         out, self._rng_dev, self.arena = self._step_fn(nb, cb, mode,
                                                        fresh)(
             self.params, self.arena, packed, self._rng_dev)
+        _dispatch_count("dispatch/host_calls")
         return np.asarray(jax.device_get(out))[:n]
 
     # -- fused decode loop (generate fast path) ----------------------------
@@ -599,8 +746,20 @@ class RaggedInferenceEngineTPU:
         a read-only arena also lets the Pallas paged kernel serve the
         history part — it walks only each sequence's true pages, where
         the XLA gather path fetches the padded page-table width.
-        `limit` (traced) dead-masks iterations past the requested step
-        count; their buffer rows are clipped by the write-back counts."""
+
+        Per-row dead-masking (all traced, no recompiles): a row goes
+        dead past the scalar `limit`, past its own `budgets[row]`
+        sampled tokens, or one step after sampling `eos_ids[row]`
+        (-1 = no eos). Dead rows stop counting and their buffer slots
+        are clipped by the per-row write-back counts, so finished rows
+        never write KV past their true end — the returned ``counts``
+        is exactly how many sampled tokens per row are valid AND how
+        many KV entries landed in the arena. Dead iterations still
+        split the sampling rng once per scan step, so a K-token window
+        produces the same sample stream whether it runs as one program
+        or several (megastep chunking invariance).
+
+        Returns ``(ys [sb, nb], counts [nb], rng, arena)``."""
         key = (nb, sb, mode)
         if key in self._fused_fns:
             return self._fused_fns[key]
@@ -616,18 +775,22 @@ class RaggedInferenceEngineTPU:
         num_layers = model.num_layers
         kvh, dh = model.kv_heads, model.head_dim
 
-        def fn(params, arena, tokens0, starts0, live, pt, limit, temp,
-               top_p, rng):
+        def fn(params, arena, tokens0, starts0, live, pt, limit, budgets,
+               eos_ids, temp, top_p, rng):
             stride = arena["k"].shape[1] // num_layers
             ak_c, av_c = arena["k"], arena["v"]       # read-only in loop
             kbuf0 = jnp.zeros((num_layers, sb, nb, kvh, dh), self.dtype)
             vbuf0 = jnp.zeros_like(kbuf0)
+            alive0 = live.astype(bool)
+            counts0 = jnp.zeros((nb,), jnp.int32)
 
             def step(carry, i):
-                tokens, rng, kbuf, vbuf = carry
-                # no in-step dead-masking needed: iterations past
-                # `limit` produce garbage the write-back clips
-                # (counts_wb) and the host slices away
+                tokens, rng, kbuf, vbuf, alive, counts = carry
+                # a row alive at step i was alive at every step before
+                # it, so counts == i for alive rows and starts0 + i is
+                # its true position; dead rows produce garbage the
+                # write-back clips (counts) and the host slices away
+                step_live = alive & (i < limit)
                 positions = (starts0 + i)[:, None]            # [nb, 1]
                 x = embed_tokens(
                     model, params["embed"], tokens[:, None],
@@ -710,14 +873,21 @@ class RaggedInferenceEngineTPU:
                 x = _norm(model, params["final_norm"], x)
                 logits = lm_logits(model, params, x)[:, 0]
                 nxt, rng = _sample_tokens(logits, mode, temp, top_p, rng)
-                return (nxt, rng, kbuf, vbuf), nxt
+                # rows alive this step emit `nxt`; a row retires AFTER
+                # emitting its eos / last-budget token, so counts ends at
+                # exactly the number of valid tokens == KV rows written
+                # (the eos token itself never writes KV — its KV slot
+                # would belong to the NEXT step's fed token)
+                counts = counts + step_live.astype(jnp.int32)
+                alive = step_live & (nxt != eos_ids) & (counts < budgets)
+                return (nxt, rng, kbuf, vbuf, alive, counts), nxt
 
-            (_, rng, kbuf, vbuf), ys = lax.scan(
-                step, (tokens0, rng, kbuf0, vbuf0),
+            (_, rng, kbuf, vbuf, _, counts), ys = lax.scan(
+                step, (tokens0, rng, kbuf0, vbuf0, alive0, counts0),
                 jnp.arange(sb, dtype=jnp.int32))
 
-            # one write-back pass: buffer rows [0, limit) per live row
-            counts_wb = live * limit
+            # one write-back pass: buffer rows [0, counts[r]) per row
+            counts_wb = counts
 
             def wb(carry, inp):
                 ak, av = carry
@@ -732,7 +902,7 @@ class RaggedInferenceEngineTPU:
             (ak, av), _ = lax.scan(
                 wb, (arena["k"], arena["v"]),
                 (kbuf, vbuf, jnp.arange(num_layers, dtype=jnp.int32)))
-            return ys, rng, {"k": ak, "v": av}
+            return ys, counts, rng, {"k": ak, "v": av}
 
         jitted = jax.jit(fn, donate_argnums=(1,))
         self._fused_fns[key] = jitted
@@ -740,7 +910,10 @@ class RaggedInferenceEngineTPU:
 
     def _fused_decode_fn_v1(self, nb: int, sb: int, mode):
         """The r4 arena-carrying loop (XLA attend, arena copied per
-        iteration) — kept for A/B via DSTPU_FUSED_V1."""
+        iteration) — kept for A/B via DSTPU_FUSED_V1. Signature-identical
+        to :meth:`_fused_decode_fn` including the per-row budget/eos
+        dead-masking (here dead rows write no KV at all: ragged_forward
+        clips by the per-row counts)."""
         key = (nb, sb, mode, "v1")
         if key in self._fused_fns:
             return self._fused_fns[key]
@@ -750,30 +923,47 @@ class RaggedInferenceEngineTPU:
             detail={"n_bucket": nb, "steps": sb, "mode": str(mode)})
         model = self.model_config
 
-        def fn(params, arena, tokens0, starts0, live, pt, limit, temp,
-               top_p, rng):
+        def fn(params, arena, tokens0, starts0, live, pt, limit, budgets,
+               eos_ids, temp, top_p, rng):
+            alive0 = live.astype(bool)
+            counts0 = jnp.zeros_like(starts0)
+
             def body(carry, i):
-                tokens, starts, arena, rng = carry
-                live_i = live * (i < limit).astype(jnp.int32)
+                tokens, starts, arena, rng, alive, counts = carry
+                live_i = (alive & (i < limit)).astype(jnp.int32)
                 logits, arena = ragged_forward(
                     model, params, arena, tokens[:, None], live_i, starts,
                     pt, use_pallas=False, moe_fn=self._moe_fn)
                 nxt, rng = _sample_tokens(logits, mode, temp, top_p, rng)
-                return (nxt, starts + live_i, arena, rng), nxt
+                counts = counts + live_i
+                alive = (live_i > 0) & (nxt != eos_ids) & \
+                    (counts < budgets)
+                return (nxt, starts + live_i, arena, rng, alive,
+                        counts), nxt
 
-            (_, _, arena, rng), ys = lax.scan(
-                body, (tokens0, starts0, arena, rng),
+            (_, _, arena, rng, _, counts), ys = lax.scan(
+                body, (tokens0, starts0, arena, rng, alive0, counts0),
                 jnp.arange(sb, dtype=jnp.int32))
-            return ys, rng, arena
+            return ys, counts, rng, arena
 
         jitted = jax.jit(fn, donate_argnums=(1,))
         self._fused_fns[key] = jitted
         return jitted
 
     def _fused_decode(self, uids: List[int], first_tokens: List[int],
-                      steps: int, mode) -> np.ndarray:
-        """Pre-allocate KV pages for `steps` more tokens per sequence,
-        then run the fused loop. Returns sampled tokens [steps, n].
+                      steps: int, mode,
+                      budgets: Optional[List[int]] = None,
+                      eos_token_id: Optional[int] = None,
+                      sb: Optional[int] = None):
+        """Pre-allocate KV pages for the decode window, then run the
+        fused loop. Returns ``(tok_mat [steps, n], counts [n])`` — row
+        ``j`` of the batch emitted ``counts[j]`` valid tokens
+        (``tok_mat[:counts[j], j]``) and wrote exactly that many KV
+        entries; rows stop early on their per-row ``budgets[j]`` or on
+        sampling ``eos_token_id`` (both optional — default is the old
+        run-out-the-window behavior). ``sb`` overrides the scan-length
+        bucket (megastep uses pow2 buckets so chunked RNG streams line
+        up; the generate path keeps ``_FUSED_STEP_BUCKET`` multiples).
         Raises FusedDecodeUnavailable when length (doomed=True — the
         stepwise loop would also overrun max_seq_len) or page capacity
         (doomed=False — fall back) can't cover the full decode."""
@@ -782,10 +972,16 @@ class RaggedInferenceEngineTPU:
             raise FusedDecodeUnavailable("empty batch")
         nb = _bucket(n)
         bs = self.state.allocator.block_size
+        # per-row effective window: a row never runs past its own budget,
+        # so pages (and the doomed check) only need to cover min(steps,
+        # budget) — without this, per-row budgets shorter than the chunk
+        # would pre-allocate pages the dead-masked tail never fills
+        eff = [steps if budgets is None else min(steps, int(budgets[j]))
+               for j in range(n)]
         need: List[int] = []
-        for u in uids:
+        for u, e in zip(uids, eff):
             seq = self.state.seqs[u]
-            final = len(seq.tokens) + steps
+            final = len(seq.tokens) + e
             if final > self.config.max_seq_len:
                 raise FusedDecodeUnavailable(
                     f"sequence {u} would reach {final} tokens, over "
@@ -799,12 +995,22 @@ class RaggedInferenceEngineTPU:
                 self.state.seqs[u].blocks.extend(
                     self.state.allocator.allocate(k))
 
-        sb = -(-steps // self._FUSED_STEP_BUCKET) * self._FUSED_STEP_BUCKET
+        if sb is None:
+            sb = -(-steps // self._FUSED_STEP_BUCKET) * \
+                self._FUSED_STEP_BUCKET
         tokens0 = np.zeros((nb,), np.int32)
         tokens0[:n] = first_tokens
         starts0 = np.zeros((nb,), np.int32)
         live = np.zeros((nb,), np.int32)
         live[:n] = 1
+        # padding rows carry budget 0 (they are dead from step 0 anyway);
+        # eos -1 never matches a sampled id, so "no eos" needs no
+        # separate compile
+        bud = np.zeros((nb,), np.int32)
+        bud[:n] = eff
+        eos = np.full((nb,), -1, np.int32)
+        if eos_token_id is not None:
+            eos[:n] = int(eos_token_id)
         pt = self._page_table(uids, nb)
         for i, u in enumerate(uids):
             starts0[i] = len(self.state.seqs[u].tokens)
@@ -815,13 +1021,20 @@ class RaggedInferenceEngineTPU:
         mb_need = int(-(-(int(starts0.max()) + steps) // bs))
         mb_b = min(self.mb, -(-mb_need // 4) * 4)
         pt = pt[:, :mb_b]
-        ys, self._rng_dev, self.arena = self._fused_decode_fn(
+        ys, counts, self._rng_dev, self.arena = self._fused_decode_fn(
             nb, sb, mode)(
                 self.params, self.arena, jnp.asarray(tokens0),
                 jnp.asarray(starts0), jnp.asarray(live), jnp.asarray(pt),
-                jnp.int32(steps), jnp.float32(self._temperature),
+                jnp.int32(steps), jnp.asarray(bud), jnp.asarray(eos),
+                jnp.float32(self._temperature),
                 jnp.float32(self._top_p), self._rng_dev)
-        return np.asarray(jax.device_get(ys))[:steps, :n]
+        _dispatch_count("dispatch/host_calls")
+        _dispatch_count("dispatch/scan_steps", sb)
+        # scan iterations past `limit` run with every row dead — pure
+        # bucket-rounding waste dstpu-explain surfaces when it dominates
+        _dispatch_count("dispatch/dead_steps", sb - steps)
+        ys, counts = jax.device_get((ys, counts))    # ONE sync
+        return np.asarray(ys)[:steps, :n], np.asarray(counts)[:n]
 
     # -- convenience serving loop ------------------------------------------
 
@@ -861,8 +1074,10 @@ class RaggedInferenceEngineTPU:
         chunk = min(self._FUSED_STEP_BUCKET,
                     max(remaining[u] for u in active))
         try:
-            tok_mat = self._fused_decode(
-                active, [cur_tok[u] for u in active], chunk, mode)
+            tok_mat, _counts = self._fused_decode(
+                active, [cur_tok[u] for u in active], chunk, mode,
+                budgets=[remaining[u] for u in active],
+                eos_token_id=eos_token_id)
         except FusedDecodeUnavailable as e:
             return active, e
         still: List[int] = []

@@ -88,7 +88,10 @@ class ServingFrontend:
                  token_budget: Optional[int] = None,
                  emit_every: int = 0, clock=time.monotonic,
                  watchdog=None, http_port: Optional[int] = None,
-                 slo_admission: bool = False):
+                 slo_admission: bool = False,
+                 megastep_tokens: Optional[int] = None,
+                 megastep_adaptive: Optional[bool] = None,
+                 config=None):
         self.engine = engine
         #: optional telemetry.Watchdog armed around each engine step — a
         #: hung decode (deadlocked collective, runaway compile) dumps
@@ -103,6 +106,26 @@ class ServingFrontend:
         self.monitor = monitor
         self.mode = mode
         self.token_budget = token_budget     # None → engine max_batch_tokens
+        # decode-megastep knobs: explicit kwargs win over a passed
+        # DeepSpeedTPUConfig/dict (its serving.* block), which wins over
+        # the defaults (megasteps off, adaptive K selection on)
+        cfg_ms, cfg_ad = 0, True
+        if config is not None:
+            srv = (config.get("serving") if isinstance(config, dict)
+                   else getattr(config, "serving", None))
+            if isinstance(srv, dict):
+                cfg_ms = int(srv.get("megastep_tokens", cfg_ms))
+                cfg_ad = bool(srv.get("megastep_adaptive", cfg_ad))
+            elif srv is not None:
+                cfg_ms = int(srv.megastep_tokens)
+                cfg_ad = bool(srv.megastep_adaptive)
+        self.megastep_tokens = (cfg_ms if megastep_tokens is None
+                                else int(megastep_tokens))
+        self.megastep_adaptive = (cfg_ad if megastep_adaptive is None
+                                  else bool(megastep_adaptive))
+        if self.megastep_tokens < 0:
+            raise ValueError("megastep_tokens must be >= 0 "
+                             f"(got {self.megastep_tokens})")
         self.emit_every = emit_every
         self.clock = clock                   # injectable for deadline tests
         self._running: Dict[int, Request] = {}
@@ -162,19 +185,22 @@ class ServingFrontend:
     def submit(self, prompt, max_new_tokens: int = 16, priority: int = 0,
                timeout: Optional[float] = None,
                deadline: Optional[float] = None,
-               stream_cb=None) -> Request:
+               stream_cb=None,
+               eos_token_id: Optional[int] = None) -> Request:
         """Admit a request or raise :class:`AdmissionError` with a reason
         (``queue_full`` | ``kv_exhausted`` | ``too_long`` |
         ``slo_unattainable``) — overload is surfaced at the door, not
         buffered into unbounded latency. ``slo_unattainable`` fires only
         with SLO admission on and a deadline the roofline model says
-        cannot be met even best-case."""
+        cannot be met even best-case. ``eos_token_id`` finishes the
+        request early (reason ``"eos"``) when that token is sampled."""
         now = self.clock()
         prompt = [int(t) for t in prompt]
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       priority=priority, stream_cb=stream_cb,
                       deadline=(now + timeout if timeout is not None
-                                else deadline))
+                                else deadline),
+                      eos_token_id=eos_token_id)
         total = len(prompt) + req.max_new_tokens
         if not prompt or total > self.engine.config.max_seq_len:
             req.state = RequestState.REJECTED
@@ -231,6 +257,53 @@ class ServingFrontend:
 
     # -- the pump -----------------------------------------------------------
 
+    def _pick_megastep(self, now: float) -> int:
+        """Tokens the next engine step may run device-resident (K).
+
+        Megastep boundaries are the ONLY points where the pump sheds,
+        cancels, admits and re-mixes prefill — so K is the knob trading
+        dispatch overhead (stepwise pays 2+ host round-trips per token)
+        against responsiveness:
+
+        - any running prefill, or K ≤ 1 configured → 1 (stepwise);
+        - K never exceeds the deepest remaining budget (no dead window);
+        - a non-empty admission queue caps K at the SHALLOWEST remaining
+          budget: the next retirement frees the slot/pages the queued
+          request is waiting on, and that boundary is an admission point;
+        - adaptive mode scales K with the decode backlog (shallow batch →
+          short windows keep latency checks frequent) and shrinks K so no
+          running/queued deadline expires mid-window (decode step time
+          from the roofline ``cost_records`` when available).
+        """
+        k = self.megastep_tokens
+        if k <= 1 or self.mode is None or not self._running:
+            return 1
+        dec, pre = self.policy.decode_backlog(self.engine.state)
+        if pre or not dec:
+            return 1                   # prefill in flight → stepwise mix
+        rem = [req.max_new_tokens - len(req.tokens_out)
+               for req in self._running.values()]
+        k = min(k, max(rem))
+        if len(self.queue):
+            k = min(k, max(1, min(rem)))
+        if self.megastep_adaptive:
+            # deep decode-only backlogs amortize dispatch best; a shallow
+            # batch keeps windows short so new arrivals wait less
+            k = min(k, max(1, dec * 8))
+            recs = self.cost_records
+            t_dec = (float(recs.get("decode", {}).get("predicted_s", 0.0))
+                     if recs else 0.0)
+            if t_dec > 0.0:
+                slacks = [req.deadline - now
+                          for req in self._running.values()
+                          if req.deadline is not None]
+                slacks += [req.deadline - now
+                           for req in list(self.queue._q)
+                           if req.deadline is not None]
+                if slacks:
+                    k = min(k, max(1, int(min(slacks) / t_dec)))
+        return max(1, k)
+
     def step(self) -> bool:
         """One pump iteration: shed → cancel → admit → engine step →
         fan tokens out. Returns True while there is (or was) work."""
@@ -250,14 +323,26 @@ class ServingFrontend:
         while self._try_admit_one(now):
             progressed = True
         self.metrics.queue_depth.record(float(len(self.queue)))
+        k = self._pick_megastep(now)
+        row_limits = eos_map = None
+        if k > 1:
+            row_limits = {uid: req.max_new_tokens - len(req.tokens_out)
+                          for uid, req in self._running.items()}
+            eos_map = {uid: req.eos_token_id
+                       for uid, req in self._running.items()
+                       if req.eos_token_id is not None}
         if self.watchdog is not None:
             self.watchdog.arm("serving_step")
         t0 = time.monotonic()
         try:
             with telemetry.tracer.span("serving/engine_step",
-                                       batch=len(self._running)):
+                                       batch=len(self._running),
+                                       max_steps=k):
                 out = self.engine.step_with_budget(budget=self.token_budget,
-                                                   mode=self.mode)
+                                                   mode=self.mode,
+                                                   max_steps=k,
+                                                   row_limits=row_limits,
+                                                   eos_ids=eos_map)
         finally:
             if self.watchdog is not None:
                 self.watchdog.disarm()
@@ -269,10 +354,12 @@ class ServingFrontend:
             kind="serving", dur_s=time.monotonic() - t0,
             batch=len(self._running), tokens=len(out))
         now = self.clock()
-        for uid, tok in out.items():
+        for uid, toks in out.items():
             req = self._running.get(uid)
             if req is None:
                 continue
+            if not isinstance(toks, list):
+                toks = [toks]
             if req.first_token_ts is None:
                 req.first_token_ts = now
                 self.metrics.ttft.record(now - (req.enqueue_ts or now))
@@ -281,19 +368,36 @@ class ServingFrontend:
                     # publish them (cache increfs what it keeps)
                     self.cache.insert(
                         req.prompt, self.engine.state.seqs[uid].blocks)
-            tok = int(tok)
-            req.tokens_out.append(tok)
-            self.metrics.bump("tokens_out")
-            if req.stream_cb is not None:
-                req.stream_cb(tok)
-            if len(req.tokens_out) >= req.max_new_tokens:
-                self._finish(req, "length", RequestState.FINISHED, now)
-            else:
+            if len(toks) > 1:
+                self.metrics.bump("megasteps")
+                self.metrics.megastep_k.record(float(len(toks)))
+            finished = False
+            for tok in toks:
+                tok = int(tok)
+                req.tokens_out.append(tok)
+                self.metrics.bump("tokens_out")
+                if req.stream_cb is not None:
+                    req.stream_cb(tok)
+                # eos outranks length: a megastep row that samples eos on
+                # its last budgeted token finished because of the eos
+                if req.eos_token_id is not None and \
+                        tok == req.eos_token_id:
+                    self._finish(req, "eos", RequestState.FINISHED, now)
+                    finished = True
+                    break
+                if len(req.tokens_out) >= req.max_new_tokens:
+                    self._finish(req, "length", RequestState.FINISHED, now)
+                    finished = True
+                    break
+            if not finished:
+                # feed the block's LAST token back — every earlier one
+                # already has KV in the arena (megastep wrote it device-
+                # side; the engine advanced the descriptor to match)
                 try:
-                    self.engine.state.extend(uid, [tok])
+                    self.engine.state.extend(uid, [toks[-1]])
                 except RuntimeError:
                     if self.cache is not None and self.cache.evict(1):
-                        self.engine.state.extend(uid, [tok])
+                        self.engine.state.extend(uid, [toks[-1]])
                     else:
                         self._finish(req, "kv_exhausted",
                                      RequestState.FINISHED, now)
@@ -349,21 +453,49 @@ class ServingFrontend:
             self.step()
         raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
 
-    def stream(self, req: Request) -> Iterator[int]:
+    def stream(self, req: Request, poll_interval: float = 0.0005,
+               stall_timeout: float = 30.0) -> Iterator[int]:
         """Yield ``req``'s tokens as they are produced, driving the pump
-        between yields (single-threaded streaming iterator)."""
+        between yields (single-threaded streaming iterator). Megastep
+        blocks drain in order, K tokens per pump.
+
+        Empty pumps back off (``poll_interval`` doubling to 50 ms) instead
+        of busy-spinning the host, and ``stall_timeout`` seconds of zero
+        progress raise with the queue/engine state an operator needs —
+        not a bare spin counter."""
         emitted = 0
-        stall = 0
+        idle_since: Optional[float] = None
+        delay = poll_interval
         while True:
             while emitted < len(req.tokens_out):
                 yield req.tokens_out[emitted]
                 emitted += 1
             if req.done:
                 return
-            stall = stall + 1 if not self.step() else 0
-            if stall > 10000:
+            if self.step():
+                idle_since = None
+                delay = poll_interval
+                continue
+            # no-op pump: nothing running, nothing admitted — wall-clock
+            # (not the injectable SLO clock) bounds the wait for work to
+            # appear before declaring the stream wedged
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > stall_timeout:
+                eng = self.engine
                 raise RuntimeError(
-                    f"stream stalled: request {req.uid} in {req.state}")
+                    f"stream stalled {stall_timeout:.2f}s with no engine "
+                    f"progress: request uid={req.uid} "
+                    f"state={req.state.value} "
+                    f"tokens_out={len(req.tokens_out)}/"
+                    f"{req.max_new_tokens}; queue_depth={len(self.queue)} "
+                    f"running={len(self._running)} free_blocks="
+                    f"{eng.state.allocator.free_blocks} free_sequences="
+                    f"{eng.config.max_sequences - len(eng.state.seqs)} — "
+                    f"was the request submitted to THIS frontend?")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
 
     def emit_metrics(self, step: Optional[int] = None) -> None:
         self.metrics.emit(self.monitor, self.cache,

@@ -34,12 +34,16 @@ class Request:
     queued request past its deadline is shed, never silently run late.
     ``stream_cb`` is invoked with each generated token id as soon as the
     frontend observes it (same thread as the engine loop — keep it cheap).
+    ``eos_token_id`` retires the request early when sampled — honored
+    ON DEVICE inside decode megasteps (the row stops writing KV
+    mid-window) and host-side on the stepwise path.
     """
     prompt: List[int]
     max_new_tokens: int = 16
     priority: int = 0
     deadline: Optional[float] = None
     stream_cb: Optional[Callable[[int], None]] = None
+    eos_token_id: Optional[int] = None
 
     uid: int = field(default_factory=lambda: next(_uid_counter))
     state: RequestState = RequestState.QUEUED

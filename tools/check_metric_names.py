@@ -34,8 +34,8 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
-KNOWN_AREAS = ("anomaly", "comm", "compile", "mem", "overlap", "roofline",
-               "serving", "train")
+KNOWN_AREAS = ("anomaly", "comm", "compile", "dispatch", "mem", "overlap",
+               "roofline", "serving", "train")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
