@@ -40,6 +40,8 @@ import json
 import os
 import shutil
 import threading
+import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -47,11 +49,64 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from deepspeed_tpu.resilience.faults import fault_injector
 from deepspeed_tpu.utils.logging import logger
 
 Pytree = Any
 
 _SEP = "."
+
+#: bounded exponential-backoff retry for transient fragment-write IO
+#: errors (NFS blips, injected faults); env-overridable for tests
+IO_RETRIES = int(os.environ.get("DSTPU_CKPT_RETRIES", "3"))
+IO_BACKOFF_S = float(os.environ.get("DSTPU_CKPT_BACKOFF_S", "0.05"))
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A tag failed integrity verification: torn/short/CRC-mismatched
+    fragment, missing fragment file, or incomplete per-process index.
+    ``load_checkpoint`` quarantines the tag and falls back to the newest
+    valid one."""
+
+
+def _write_fragment(path: str, data: bytes, retries: int = None,
+                    backoff_s: float = None) -> None:
+    """One fragment write with bounded exponential-backoff retry on
+    ``OSError`` (the transient class: full/flaky network filesystems).
+    The chaos hook sits INSIDE the loop so an injected
+    ``io_error:checkpoint`` exercises exactly this retry path."""
+    retries = IO_RETRIES if retries is None else retries
+    backoff_s = IO_BACKOFF_S if backoff_s is None else backoff_s
+    last: Optional[OSError] = None
+    for attempt in range(retries + 1):
+        try:
+            # advisory=False: torn_fragment stays pending for commit(),
+            # which owns the file-tearing mechanics
+            fault_injector.fire("checkpoint", advisory=False)
+            with open(path, "wb") as fh:
+                fh.write(data)
+            if last is not None:
+                from deepspeed_tpu.resilience.faults import record_recovery
+                record_recovery("ckpt_io_retry", path=os.path.basename(path),
+                                attempts=attempt + 1)
+            return
+        except OSError as e:
+            last = e
+            try:
+                from deepspeed_tpu import telemetry
+                telemetry.registry.counter(
+                    "resilience/ckpt_retries",
+                    help="checkpoint fragment writes retried after "
+                         "transient IO errors").inc()
+            except Exception:                        # noqa: BLE001
+                pass
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            logger.warning(f"checkpoint write {os.path.basename(path)} "
+                           f"failed ({e}); retry {attempt + 1}/{retries} "
+                           f"in {delay:.3f}s")
+            time.sleep(delay)
 
 
 def _np_dtype(name: str):
@@ -160,7 +215,8 @@ def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
             f"could not clear checkpoint dir {root}") from clear_err
 
     # ---- synchronous snapshot (before donation can invalidate buffers)
-    work: List[Tuple[str, np.ndarray]] = []     # (path, host array)
+    # (path, host array, index fragment record — CRC stamped at commit)
+    work: List[Tuple[str, np.ndarray, Dict[str, Any]]] = []
     index: Dict[str, Dict[str, Any]] = {}
     for group, tree in state.items():
         gdir = os.path.join(root, "state", group)
@@ -172,17 +228,33 @@ def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
             frags = []
             for k, (starts, stops, arr) in enumerate(shards):
                 fname = f"{key.replace('/', '_')}.p{pidx}f{k}.bin"
+                frag = {"file": fname, "start": starts, "stop": stops}
                 work.append((os.path.join(gdir, fname),
-                             np.ascontiguousarray(arr)))
-                frags.append({"file": fname, "start": starts, "stop": stops})
+                             np.ascontiguousarray(arr), frag))
+                frags.append(frag)
             if frags:       # processes owning no shard of this leaf skip it
                 index.setdefault(group, {})[key] = {
                     "shape": full_shape, "dtype": dtype, "fragments": frags}
 
     def commit():
-        for path, arr in work:
-            with open(path, "wb") as fh:
-                fh.write(arr.tobytes())
+        for path, arr, frag in work:
+            data = arr.tobytes()
+            # integrity stamp: the loader verifies bytes+CRC per fragment
+            # and falls back to the previous valid tag on a torn read
+            frag["bytes"] = len(data)
+            frag["crc32"] = zlib.crc32(data) & 0xFFFFFFFF
+            _write_fragment(path, data)
+        # chaos: a scheduled torn_fragment truncates one just-written
+        # fragment AFTER its (correct) CRC was stamped — exactly the
+        # torn-write the loader's verification must catch
+        if "torn_fragment" in fault_injector.fire("checkpoint") and work:
+            victim = work[-1][0]
+            size = os.path.getsize(victim)
+            with open(victim, "r+b") as fh:
+                fh.truncate(max(0, size // 2))
+            logger.warning(f"CHAOS: tore checkpoint fragment "
+                           f"{os.path.basename(victim)} "
+                           f"({size} -> {max(0, size // 2)} bytes)")
         # per-process meta LAST — its presence commits this process's part
         payload = {"meta": meta, "index": index, "version": 2,
                    "process_count": jax.process_count()}
@@ -229,8 +301,29 @@ def _publish_latest(ent: Dict[str, Any]) -> None:
     """Write the ``latest`` marker (p0 only). Callers must have already
     agreed all processes committed."""
     if ent["save_latest"] and jax.process_index() == 0:
-        with open(os.path.join(ent["save_dir"], "latest"), "w") as fh:
-            fh.write(ent["tag"])
+        _write_latest(ent["save_dir"], ent["tag"])
+
+
+def _write_latest(save_dir: str, tag: str) -> None:
+    """Atomic+durable ``latest`` publish: temp file, fsync, ``os.replace``
+    (atomic on POSIX), then directory fsync — a crash mid-publish leaves
+    either the old marker or the new one, never a torn read, and the
+    marker survives power loss once this returns."""
+    path = os.path.join(save_dir, "latest")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(tag)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(save_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # e.g. directories not fsync-able on this filesystem
 
 
 def _drain_pending() -> Tuple[Optional[BaseException], List[Dict[str, Any]]]:
@@ -301,7 +394,7 @@ def _read_merged_index(root: str) -> Tuple[Dict[str, Any],
                                    "dtype": entry["dtype"],
                                    "fragments": list(entry["fragments"])}
     if expected is not None and len(pfiles) != expected:
-        raise RuntimeError(
+        raise CheckpointCorrupt(
             f"incomplete checkpoint at {root}: {len(pfiles)} of "
             f"{expected} per-process index files present")
     return meta, index
@@ -315,8 +408,35 @@ def latest_tag(load_dir: str) -> Optional[str]:
         return fh.read().strip()
 
 
+def _read_fragment(gdir: str, f: Dict[str, Any], dtype) -> np.ndarray:
+    """Read one fragment, verifying byte length and CRC32 when the index
+    carries them (every v2 save since the integrity stamp; older
+    checkpoints load unverified). A short read or checksum mismatch is a
+    TORN fragment — raise :class:`CheckpointCorrupt` so the loader falls
+    back instead of resuming from garbage bytes."""
+    path = os.path.join(gdir, f["file"])
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except FileNotFoundError as e:
+        raise CheckpointCorrupt(
+            f"missing checkpoint fragment {f['file']}") from e
+    if "bytes" in f and len(raw) != int(f["bytes"]):
+        raise CheckpointCorrupt(
+            f"torn checkpoint fragment {f['file']}: {len(raw)} bytes on "
+            f"disk, {f['bytes']} at commit")
+    if "crc32" in f:
+        crc = zlib.crc32(raw) & 0xFFFFFFFF
+        if crc != int(f["crc32"]):
+            raise CheckpointCorrupt(
+                f"checkpoint fragment {f['file']} failed CRC32 "
+                f"verification ({crc:#010x} != {int(f['crc32']):#010x})")
+    return np.frombuffer(raw, dtype=dtype)
+
+
 def _assemble(gdir: str, entry: Dict[str, Any]) -> np.ndarray:
-    """Fragments → full np array (any-mesh reshape happens at device_put)."""
+    """Fragments → full np array (any-mesh reshape happens at device_put),
+    CRC-verified per fragment."""
     dtype = _np_dtype(entry["dtype"])
     shape = tuple(entry["shape"])
     if "fragments" not in entry:
@@ -329,12 +449,11 @@ def _assemble(gdir: str, entry: Dict[str, Any]) -> np.ndarray:
     frags = entry["fragments"]
     if len(frags) == 1 and tuple(frags[0]["start"]) == (0,) * len(shape) \
             and tuple(frags[0]["stop"]) == shape:
-        raw = np.fromfile(os.path.join(gdir, frags[0]["file"]), dtype=dtype)
-        return raw.reshape(shape)
+        return _read_fragment(gdir, frags[0], dtype).reshape(shape)
     out = np.empty(shape, dtype)
     for f in frags:
         sl = tuple(slice(a, b) for a, b in zip(f["start"], f["stop"]))
-        piece = np.fromfile(os.path.join(gdir, f["file"]), dtype=dtype)
+        piece = _read_fragment(gdir, f, dtype)
         out[sl] = piece.reshape(tuple(b - a for a, b in
                                       zip(f["start"], f["stop"])))
     return out
@@ -364,10 +483,52 @@ def _missing_leaf_is_critical(group: str, key: str) -> bool:
     return key.split(_SEP, 1)[0] not in _FORWARD_COMPAT_LEAVES
 
 
+def _quarantine_tag(load_dir: str, tag: str, why: BaseException) -> None:
+    """Move a corrupt tag dir aside (``<tag>.quarantined``) so auto-resume
+    never lands on it again; p0 only, best effort (a rename failure just
+    leaves the dir to be skipped by the excluded-tags set)."""
+    if jax.process_index() != 0:
+        return
+    src = os.path.join(load_dir, tag)
+    dst = f"{src}.quarantined"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}.quarantined.{n}"
+    try:
+        os.replace(src, dst)
+        logger.error(f"checkpoint tag '{tag}' QUARANTINED -> "
+                     f"{os.path.basename(dst)}: {why}")
+    except OSError as e:
+        logger.error(f"checkpoint tag '{tag}' corrupt ({why}) and could "
+                     f"not be quarantined: {e}")
+
+
+def _candidate_tags(load_dir: str, exclude=()) -> List[str]:
+    """Committed tags newest-first (by index mtime), skipping quarantined
+    dirs and ``exclude`` — the fallback search order."""
+    out = []
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return out
+    for name in names:
+        if name in exclude or ".quarantined" in name:
+            continue
+        root = os.path.join(load_dir, name)
+        if not os.path.isdir(root):
+            continue
+        metas = [os.path.join(root, f) for f in os.listdir(root)
+                 if f.startswith("meta") and f.endswith(".json")]
+        if metas:
+            out.append((max(os.path.getmtime(m) for m in metas), name))
+    return [name for _, name in sorted(out, reverse=True)]
+
+
 def load_checkpoint(load_dir: str, tag: Optional[str],
                     templates: Dict[str, Pytree],
                     shardings: Dict[str, Pytree],
-                    strict=True
+                    strict=True, fallback: bool = True
                     ) -> Tuple[Optional[Dict[str, Pytree]],
                                Dict[str, Any], Optional[str]]:
     """Load state matching ``templates`` structure, placing each leaf with
@@ -380,11 +541,66 @@ def load_checkpoint(load_dir: str, tag: Optional[str],
     entirely absent from the checkpoint is NOT an error — that is a
     cross-mode checkpoint (e.g. host-offload runs keep optimizer state in
     ``host_optimizer.npz``, params-only exports); the group is omitted from
-    the returned dict so the caller can rebuild it."""
+    the returned dict so the caller can rebuild it.
+
+    With ``fallback`` (the default), a tag that fails integrity
+    verification (torn/CRC-mismatched fragment, incomplete index) is
+    QUARANTINED and the newest remaining valid tag is loaded instead —
+    auto-resume survives a checkpoint torn by the very preemption it is
+    resuming from. Each hop bumps ``resilience/ckpt_fallbacks``; the
+    original error re-raises when no valid tag remains."""
     wait_pending()
     tag = tag or latest_tag(load_dir)
     if tag is None:
         return None, {}, None
+    first_err: Optional[BaseException] = None
+    tried: set = set()
+    while True:
+        try:
+            out = _load_tag(load_dir, tag, templates, shardings, strict)
+            if tried:
+                # recovered onto a fallback tag: repoint auto-resume and
+                # close the faults_injected == recoveries ledger
+                if jax.process_index() == 0:
+                    try:
+                        _write_latest(load_dir, tag)
+                    except OSError:
+                        pass
+                from deepspeed_tpu.resilience.faults import record_recovery
+                record_recovery("ckpt_fallback", to_tag=tag,
+                                bad_tags=sorted(tried))
+            return out
+        except (CheckpointCorrupt, FileNotFoundError) as e:
+            first_err = first_err or e
+            if not fallback:
+                raise
+            tried.add(tag)
+            logger.error(f"checkpoint '{tag}' failed verification: {e}")
+            _quarantine_tag(load_dir, tag, e)
+            try:
+                from deepspeed_tpu import telemetry
+                telemetry.registry.counter(
+                    "resilience/ckpt_fallbacks",
+                    help="corrupt-tag fallbacks during checkpoint "
+                         "load").inc()
+                telemetry.flight_recorder.record_event(
+                    "ckpt_fallback", bad_tag=tag, error=str(e)[:200])
+            except Exception:                        # noqa: BLE001
+                pass
+            candidates = _candidate_tags(load_dir, exclude=tried)
+            if not candidates:
+                logger.error(f"no valid checkpoint tag left in {load_dir} "
+                             f"(tried {sorted(tried)})")
+                raise first_err
+            tag = candidates[0]
+            logger.warning(f"falling back to newest valid checkpoint "
+                           f"tag '{tag}'")
+
+
+def _load_tag(load_dir: str, tag: str, templates: Dict[str, Pytree],
+              shardings: Dict[str, Pytree], strict
+              ) -> Tuple[Optional[Dict[str, Pytree]],
+                         Dict[str, Any], Optional[str]]:
     root = os.path.join(load_dir, tag)
     meta, index = _read_merged_index(root)
     if strict is True:

@@ -447,6 +447,27 @@ class ServingConfig(TPUConfigModel):
     megastep_adaptive: bool = True
 
 
+class ResilienceConfig(TPUConfigModel):
+    """``"resilience"`` block → deepspeed_tpu/resilience (fault injection
+    + recovery policy; docs/resilience.md). The fault plan makes chaos
+    testing a config key: the same plan replays the same faults at the
+    same steps, so recovery paths run in CI instead of for the first
+    time in production."""
+    #: deterministic fault schedule (';'-separated
+    #: ``<trigger>:<at>:<kind>[:<site>]`` entries — see
+    #: resilience/faults.py); env ``DSTPU_FAULT_PLAN`` adds to it.
+    #: None → injector disarmed (production default).
+    fault_plan: Optional[str] = None
+    #: bounded exponential-backoff retries for transient checkpoint
+    #: fragment-write IO errors (checkpoint/store.py)
+    ckpt_io_retries: int = Field(default=3, ge=0)
+    #: initial retry backoff, doubling per attempt
+    ckpt_io_backoff_s: float = Field(default=0.05, ge=0)
+    #: engine faults a running serving request survives before it is
+    #: finished with reason ``"error"`` (serving/frontend.py)
+    serving_retry_budget: int = Field(default=2, ge=0)
+
+
 class TensorBoardConfig(TPUConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -572,6 +593,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)

@@ -13,7 +13,8 @@ relaunch itself is the launcher's job, launcher/runner.py).
 import os
 import signal
 import sys
-from typing import Any, Callable, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Type
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -48,6 +49,7 @@ class DSElasticAgent:
         self.save_dir = save_dir
         self.save_on = save_on
         self._signaled = False
+        self._committing = False
         self._prev_handlers: Dict[int, Any] = {}
 
     def install(self) -> None:
@@ -66,6 +68,12 @@ class DSElasticAgent:
                        f"{signal.Signals(signum).name}; will checkpoint "
                        f"at the next step boundary")
         self._signaled = True
+        # chain to whatever was installed before us (a launcher's own
+        # handler, a test harness) — installing the agent must not
+        # silently disconnect someone else's signal logic
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
 
     @property
     def preemption_pending(self) -> bool:
@@ -77,6 +85,13 @@ class DSElasticAgent:
         way)."""
         if not self._signaled:
             return
+        # re-entrancy guard: a SECOND SIGTERM landing while the commit
+        # below runs re-enters here via the chained handler / a nested
+        # boundary call; committing the same tag twice would race the
+        # fragment writes against themselves
+        if self._committing:
+            return
+        self._committing = True
         tag = f"preempt_step{self.engine.global_steps}"
         self.engine.save_checkpoint(self.save_dir, tag=tag)
         # dump the flight recorder next to the checkpoint: the relaunch
@@ -105,6 +120,13 @@ class DSElasticAgent:
         if tag:
             log_dist(f"elastic agent: resumed from '{tag}' at step "
                      f"{self.engine.global_steps}")
+            if tag.startswith("preempt_"):
+                # closes the loop on an injected (or real) preemption:
+                # the fault is recovered once training restarts from
+                # the boundary checkpoint it forced
+                from deepspeed_tpu.resilience.faults import record_recovery
+                record_recovery("elastic_resume", tag=tag,
+                                step=self.engine.global_steps)
         return tag
 
 
@@ -149,21 +171,43 @@ def elastic_resume(model, ds_config: Dict[str, Any], save_dir: str,
     return engine, agent, tag
 
 
-def run_elastic(train_fn: Callable[[int], Any], max_restarts: int = 3
-                ) -> Any:
+#: exception types a restart cannot fix — a bad config or a coding bug
+#: fails identically on every attempt; retrying only delays the report
+NON_TRANSIENT: Tuple[Type[BaseException], ...] = (
+    ValueError, TypeError, KeyError, NotImplementedError, AssertionError)
+
+
+def run_elastic(train_fn: Callable[[int], Any], max_restarts: int = 3,
+                backoff_s: float = 1.0, max_backoff_s: float = 30.0,
+                _sleep=time.sleep) -> Any:
     """In-process restart loop (reference DSElasticAgent._invoke_run:127
     restart-on-failure semantics). ``train_fn(attempt)`` should build its
     engine, ``resume()``, and train; transient exceptions trigger a
-    restart up to ``max_restarts``; ``Preempted`` exits cleanly."""
+    restart (with capped exponential backoff) up to ``max_restarts``.
+
+    What does NOT restart: ``Preempted`` exits cleanly (the relaunch is
+    the launcher's job); ``KeyboardInterrupt``/``SystemExit`` propagate —
+    an operator's Ctrl-C must stop the job, not schedule attempt 2; and
+    :data:`NON_TRANSIENT` types re-raise immediately — deterministic
+    failures never earn a retry."""
     last: Optional[BaseException] = None
     for attempt in range(max_restarts + 1):
         try:
             return train_fn(attempt)
         except Preempted:
             raise
-        except BaseException as e:      # noqa: BLE001 — restart policy
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except NON_TRANSIENT:
+            raise
+        except Exception as e:          # noqa: BLE001 — restart policy
             last = e
+            if attempt >= max_restarts:
+                break
+            delay = min(backoff_s * (2 ** attempt), max_backoff_s)
             logger.warning(f"elastic restart {attempt + 1}/{max_restarts} "
-                           f"after: {e}")
+                           f"after: {e} (backoff {delay:.1f}s)")
+            if delay > 0:
+                _sleep(delay)
     raise RuntimeError(
         f"training failed after {max_restarts} restarts") from last

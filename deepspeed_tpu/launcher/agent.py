@@ -39,11 +39,20 @@ class LaunchAgent:
 
     def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None,
                  max_restarts: int = 0, restart_backoff_s: float = 5.0,
+                 max_backoff_s: float = 60.0,
+                 restart_window_s: float = 300.0,
                  heartbeat_file: Optional[str] = None):
         self.cmd = cmd
         self.env = {**os.environ, **(env or {})}
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
+        self.max_backoff_s = max_backoff_s
+        #: rolling restart budget: only restarts within the last
+        #: ``restart_window_s`` seconds count against ``max_restarts`` —
+        #: a worker that dies once a day is healthy; one that dies
+        #: max_restarts times in five minutes is crash-looping
+        self.restart_window_s = restart_window_s
+        self._restart_times: List[float] = []
         self.heartbeat_file = heartbeat_file or \
             self.env.get("DSTPU_HEARTBEAT_FILE")
         if self.heartbeat_file:
@@ -91,6 +100,10 @@ class LaunchAgent:
         try:
             attempt = 0
             while True:
+                # chaos hook: lets a fault plan target the supervisor
+                # itself (a launcher-scoped hang or preempt)
+                from deepspeed_tpu.resilience.faults import fault_injector
+                fault_injector.fire("launcher")
                 log_dist(f"launch agent: starting worker "
                          f"(attempt {attempt + 1}): "
                          f"{' '.join(self.cmd)}")
@@ -102,17 +115,37 @@ class LaunchAgent:
                 self._beat("worker_exited", rc=rc, attempt=attempt)
                 if rc == 0 or self._terminating:
                     return rc
-                if attempt >= self.max_restarts:
+                now = time.monotonic()
+                self._restart_times = [
+                    t for t in self._restart_times
+                    if now - t <= self.restart_window_s]
+                if len(self._restart_times) >= self.max_restarts:
                     logger.error(
-                        f"launch agent: worker failed (rc={rc}) after "
-                        f"{attempt + 1} attempts; giving up")
+                        f"launch agent: worker failed (rc={rc}) with "
+                        f"{len(self._restart_times)} restarts already in "
+                        f"the last {self.restart_window_s:.0f}s "
+                        f"(budget {self.max_restarts}); giving up")
+                    self._beat("crash_loop", rc=rc,
+                               restarts_in_window=len(self._restart_times),
+                               attempt=attempt)
                     return rc
+                self._restart_times.append(now)
                 attempt += 1
+                delay = min(
+                    self.restart_backoff_s *
+                    (2 ** (len(self._restart_times) - 1)),
+                    self.max_backoff_s)
                 logger.warning(
                     f"launch agent: worker rc={rc}; restart "
-                    f"{attempt}/{self.max_restarts} in "
-                    f"{self.restart_backoff_s}s")
-                time.sleep(self.restart_backoff_s)
+                    f"{len(self._restart_times)}/{self.max_restarts} "
+                    f"(window {self.restart_window_s:.0f}s) in "
+                    f"{delay:.1f}s")
+                # doctor reads this phase + count to name a crash-looping
+                # host from the heartbeat alone
+                self._beat("restart_backoff", rc=rc, backoff_s=delay,
+                           restarts_in_window=len(self._restart_times),
+                           attempt=attempt)
+                time.sleep(delay)
                 if self._terminating:
                     # SIGTERM landed during the backoff (preemption):
                     # spawning a fresh worker that never saw the signal

@@ -1,0 +1,24 @@
+"""Resilience: deterministic fault injection + end-to-end recovery.
+
+``faults.py`` is the chaos schedule (``dstpu-chaos``); the recovery
+mechanics live where the state lives — checkpoint/store.py (CRC +
+fallback), runtime/engine.py (resume parity), serving/frontend.py
+(failure domain), elasticity + launcher (restart policy). This package
+is the injection/accounting spine they share.
+"""
+
+from deepspeed_tpu.resilience.faults import (ACTION_KINDS, ADVISORY_KINDS,
+                                             KINDS, SITES, TRIGGERS,
+                                             FaultEntry, FaultInjector,
+                                             InjectedEngineError,
+                                             InjectedFault, InjectedIOError,
+                                             fault_injector,
+                                             parse_fault_plan,
+                                             record_recovery)
+
+__all__ = [
+    "ACTION_KINDS", "ADVISORY_KINDS", "KINDS", "SITES", "TRIGGERS",
+    "FaultEntry", "FaultInjector", "InjectedEngineError", "InjectedFault",
+    "InjectedIOError", "fault_injector", "parse_fault_plan",
+    "record_recovery",
+]

@@ -59,6 +59,11 @@ class DeepSpeedTPUDataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.data_sampler = data_sampler
         self.epoch = 0
+        #: microbatches already served this epoch — the resume cursor. A
+        #: fresh ``iter()`` continues FROM the cursor (the epoch order is
+        #: deterministic in (seed, epoch), so position is the whole
+        #: dataloader state); ``set_epoch`` rewinds it to 0.
+        self._cursor = 0
         if len(dataset) < self.global_batch:
             raise ValueError(
                 f"dataset of {len(dataset)} items smaller than one global "
@@ -72,6 +77,24 @@ class DeepSpeedTPUDataLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self._cursor = 0
+
+    def state_dict(self) -> Dict[str, int]:
+        """Exact resume cursor: (epoch, microbatches served within it).
+        Checkpointed by the engine so a preempted-and-resumed run feeds
+        the training loop the SAME batch sequence the uninterrupted run
+        would have seen (resume parity)."""
+        return {"epoch": int(self.epoch), "cursor": int(self._cursor),
+                "seed": int(self.seed)}
+
+    def load_state_dict(self, sd: Dict[str, int]) -> None:
+        if int(sd.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"dataloader seed mismatch on resume: checkpoint has "
+                f"{sd['seed']}, loader built with {self.seed} — the "
+                f"shuffled orders would diverge silently")
+        self.epoch = int(sd.get("epoch", 0))
+        self._cursor = int(sd.get("cursor", 0))
 
     def _local_slice(self, idx: np.ndarray) -> np.ndarray:
         """This process's contiguous slice of a global index batch. The
@@ -99,7 +122,11 @@ class DeepSpeedTPUDataLoader:
             rng.shuffle(order)
         usable = len(order) - (len(order) % self.global_batch
                                if self.drop_last else 0)
-        for start in range(0, usable, self.global_batch):
+        # the epoch order is a pure function of (seed, epoch), so resuming
+        # is just skipping ``cursor`` microbatches' worth of indices —
+        # no data is loaded for the skipped span
+        for start in range(self._cursor * self.global_batch, usable,
+                           self.global_batch):
             idx = order[start:start + self.global_batch]
             if len(idx) < self.global_batch:
                 if self.drop_last:
@@ -108,6 +135,7 @@ class DeepSpeedTPUDataLoader:
                 idx = np.concatenate(
                     [idx, order[:self.global_batch - len(idx)]])
             idx = self._local_slice(idx)
+            self._cursor += 1
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 
 

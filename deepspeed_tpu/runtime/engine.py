@@ -41,6 +41,7 @@ from deepspeed_tpu.parallel.mesh import (ZERO_AXES, build_mesh,
 from deepspeed_tpu.runtime.loss_scaler import (LossScaleState, check_overflow,
                                                init_loss_scale, update_scale)
 from deepspeed_tpu.runtime.lr_schedules import Schedule, build_schedule
+from deepspeed_tpu.resilience.faults import fault_injector, record_recovery
 from deepspeed_tpu.runtime.zero.sharding import ZeroShardingPlan
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -695,11 +696,18 @@ class DeepSpeedTPUEngine:
         gas = int(self.config.gradient_accumulation_steps)
         own_data = data_iter is None
         it = data_iter if data_iter is not None else self._own_data_iterator()
+        # chaos hook (resilience/faults.py): a scheduled preempt delivers
+        # SIGTERM here — this step completes and the elastic agent commits
+        # at its boundary; a nonfinite_grad advisory poisons THIS step
+        # (handled after the batch is consumed, like an overflow skip)
+        chaos = fault_injector.fire("train_step", step=self.global_steps)
         micros = [next(it) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
         if self.config.check_nan_inf:
             self._check_batch_consistency(micros, local=own_data)
         batch = self._place_stacked_batch(batch, local=own_data)
+        if "nonfinite_grad" in chaos:
+            return self._skip_poisoned_step(gas)
         self.tput_timer.start()
         self._step_t0 = telemetry.tracer.now()
         if self._watchdog is not None:
@@ -776,6 +784,28 @@ class DeepSpeedTPUEngine:
         self._close_step_span()
         self._write_monitor(metrics)
         return loss
+
+    def _skip_poisoned_step(self, gas: int) -> jax.Array:
+        """Recovery path for an injected ``nonfinite_grad``: treat the
+        step exactly like an fp16 overflow skip — the batch is consumed,
+        the host rng advances, every counter moves, but params/opt_state
+        stay untouched and the returned loss is NaN. Keeping the rng and
+        counter discipline identical to a real step is what lets a
+        chaos run keep bitwise resume parity with an uninterrupted one."""
+        self._rng, _ = jax.random.split(self._rng)
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.global_samples += int(self.config.train_batch_size)
+        self.skipped_steps += 1
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
+        metrics = {"loss": float("nan"), "grad_norm": float("nan"),
+                   "overflow": 1}
+        self._last_metrics = metrics
+        record_recovery("skip_nonfinite", step=self.global_steps)
+        self._close_step_span()
+        self._write_monitor(metrics)
+        return jnp.float32(float("nan"))
 
     def _check_batch_consistency(self, micros, local: bool = False) -> None:
         """Cross-process dataloader consistency (reference
@@ -1098,6 +1128,16 @@ class DeepSpeedTPUEngine:
                 log_dist("\n" + _explain.render(report))
             except Exception as e:                   # noqa: BLE001
                 logger.warning(f"explain_startup failed (non-fatal): {e}")
+        # -- resilience: arm the deterministic fault injector from config
+        # (env DSTPU_FAULT_PLAN is merged inside arm()) and push the
+        # checkpoint IO retry knobs into the store module
+        rcfg = getattr(self.config, "resilience", None)
+        if rcfg is not None:
+            from deepspeed_tpu.checkpoint import store as _ckpt_store
+            _ckpt_store.IO_RETRIES = int(rcfg.ckpt_io_retries)
+            _ckpt_store.IO_BACKOFF_S = float(rcfg.ckpt_io_backoff_s)
+            if rcfg.fault_plan or os.environ.get("DSTPU_FAULT_PLAN"):
+                fault_injector.arm(rcfg.fault_plan)
         self._metrics_server = None
         if tcfg.http_port is not None:
             import atexit
@@ -1359,6 +1399,14 @@ class DeepSpeedTPUEngine:
             "offload": self.offload_enabled,
             "data_sampler": (self.data_sampler.state_dict()
                              if self.data_sampler is not None else None),
+            # exact-resume state: host PRNG key + dataloader cursor. With
+            # these a preempt-at-step-k resume replays the SAME rng splits
+            # and batch sequence the uninterrupted run would have seen
+            "rng": np.asarray(jax.device_get(self._rng)).tolist(),
+            "dataloader": (self.training_dataloader.state_dict()
+                           if self.training_dataloader is not None and
+                           hasattr(self.training_dataloader, "state_dict")
+                           else None),
         }
         root = _save(save_dir, tag, state, meta, save_latest=save_latest,
                      async_save=async_save)
@@ -1403,6 +1451,7 @@ class DeepSpeedTPUEngine:
             self.global_steps = meta.get("global_steps", 0)
             self.micro_steps = meta.get("micro_steps", 0)
             self.global_samples = meta.get("global_samples", 0)
+            self._restore_resume_state(meta)
             return tag, meta.get("client_state", {})
         shardings = {
             "params": self._param_shardings,
@@ -1463,7 +1512,22 @@ class DeepSpeedTPUEngine:
         self.global_samples = meta.get("global_samples", 0)
         if self.data_sampler is not None and meta.get("data_sampler"):
             self.data_sampler.load_state_dict(meta["data_sampler"])
+        self._restore_resume_state(meta)
         return tag, meta.get("client_state", {})
+
+    def _restore_resume_state(self, meta: Dict[str, Any]) -> None:
+        """Restore the exact-resume extras (host rng key + dataloader
+        cursor) from checkpoint meta. Older checkpoints simply lack the
+        keys — resume still works, just without bitwise parity."""
+        if meta.get("rng") is not None:
+            self._rng = jnp.asarray(
+                np.asarray(meta["rng"], dtype=np.uint32))
+        if meta.get("dataloader") and self.training_dataloader is not None \
+                and hasattr(self.training_dataloader, "load_state_dict"):
+            self.training_dataloader.load_state_dict(meta["dataloader"])
+            # drop any half-consumed iterator so the next train_batch
+            # builds a fresh one starting AT the restored cursor
+            self._data_iter = None
 
 
 # ---------------------------------------------------------------------------

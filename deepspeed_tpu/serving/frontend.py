@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from deepspeed_tpu import telemetry
+from deepspeed_tpu.resilience.faults import fault_injector, record_recovery
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue
@@ -91,6 +92,7 @@ class ServingFrontend:
                  slo_admission: bool = False,
                  megastep_tokens: Optional[int] = None,
                  megastep_adaptive: Optional[bool] = None,
+                 retry_budget: Optional[int] = None,
                  config=None):
         self.engine = engine
         #: optional telemetry.Watchdog armed around each engine step — a
@@ -123,6 +125,21 @@ class ServingFrontend:
                                 else int(megastep_tokens))
         self.megastep_adaptive = (cfg_ad if megastep_adaptive is None
                                   else bool(megastep_adaptive))
+        # engine-fault retry budget (resilience.serving_retry_budget):
+        # times ONE request may be requeued after an engine step died
+        # under it before it finishes with reason "error"
+        cfg_rb = 2
+        if config is not None:
+            rcfg = (config.get("resilience") if isinstance(config, dict)
+                    else getattr(config, "resilience", None))
+            if isinstance(rcfg, dict):
+                cfg_rb = int(rcfg.get("serving_retry_budget", cfg_rb))
+            elif rcfg is not None:
+                cfg_rb = int(rcfg.serving_retry_budget)
+        self.retry_budget = (cfg_rb if retry_budget is None
+                             else int(retry_budget))
+        #: pump iterations — the ``serving_step`` chaos trigger counts these
+        self._pump_steps = 0
         if self.megastep_tokens < 0:
             raise ValueError("megastep_tokens must be >= 0 "
                              f"(got {self.megastep_tokens})")
@@ -334,18 +351,31 @@ class ServingFrontend:
         if self.watchdog is not None:
             self.watchdog.arm("serving_step")
         t0 = time.monotonic()
+        self._pump_steps += 1
         try:
             with telemetry.tracer.span("serving/engine_step",
                                        batch=len(self._running),
                                        max_steps=k):
+                # chaos hook: an engine_error entry raises HERE so the
+                # injected fault exercises the same except-path a real
+                # engine failure takes
+                fault_injector.fire("serving_step",
+                                    serving_step=self._pump_steps)
                 out = self.engine.step_with_budget(budget=self.token_budget,
                                                    mode=self.mode,
                                                    max_steps=k,
                                                    row_limits=row_limits,
                                                    eos_ids=eos_map)
+        except Exception as e:                       # noqa: BLE001
+            # serving failure domain: one engine fault must cost at most
+            # one retry per in-flight request, never a wedged replica
+            self._on_engine_fault(e, self.clock())
+            self._update_degraded()
+            return True
         finally:
             if self.watchdog is not None:
                 self.watchdog.disarm()
+        self._update_degraded()
         if out is None:
             return progressed or bool(self._running or len(self.queue))
         self.metrics.bump("engine_steps")
@@ -404,6 +434,10 @@ class ServingFrontend:
         if self.emit_every and self.metrics.counters["engine_steps"] % \
                 self.emit_every == 0:
             self.emit_metrics()
+        # re-evaluate AFTER fan-out: the step that finishes the last
+        # retried request must flip /healthz back to healthy — no later
+        # pump is guaranteed once the replica drains idle
+        self._update_degraded()
         return True
 
     def _finish(self, req: Request, reason: str, state: RequestState,
@@ -421,6 +455,75 @@ class ServingFrontend:
             self.metrics.bump("completed")
         elif state is RequestState.CANCELLED:
             self.metrics.bump("cancelled")
+
+    def _on_engine_fault(self, err: BaseException, now: float) -> None:
+        """Engine-step failure domain. The engine's device state after a
+        mid-step exception is unknowable from here, so every in-flight
+        request is flushed (KV pages released — pages never leak on a
+        fault), its prefix-cache subtree invalidated (the pages'
+        contents are suspect), and the request either requeued at the
+        head of the admission queue (tokens already streamed fold into
+        the prompt, so re-prefill reproduces the decode state and
+        nothing is re-emitted) or — budget exhausted — finished with
+        reason ``"error"`` so ``stream()`` terminates instead of
+        stalling."""
+        from deepspeed_tpu.utils.logging import logger
+        telemetry.registry.counter(
+            "resilience/serving_engine_faults",
+            help="engine-step failures absorbed by the serving "
+                 "failure domain").inc()
+        telemetry.flight_recorder.record_event(
+            "serving_engine_fault", error=f"{type(err).__name__}: {err}",
+            batch=len(self._running), pump_step=self._pump_steps)
+        requeued = errored = 0
+        for uid, req in list(self._running.items()):
+            try:
+                self.engine.flush(uid)
+            except Exception:                        # noqa: BLE001
+                pass  # sequence may be half-torn; pages the engine still
+                      # tracks are reclaimed with it
+            self.policy.forget(uid)
+            self._running.pop(uid, None)
+            if self.cache is not None:
+                self.cache.invalidate(req.prompt)
+            if req.retries < self.retry_budget:
+                req.retries += 1
+                # KV for already-streamed tokens died with the flush;
+                # folding them into the prompt re-prefills exactly that
+                # state — the client's stream continues where it was
+                req.prompt = req.prompt + req.tokens_out
+                req.state = RequestState.QUEUED
+                req.first_token_ts = None
+                self.queue._q.insert(0, req)
+                self.metrics.bump("requeued_engine_fault")
+                telemetry.registry.counter(
+                    "resilience/serving_requeued",
+                    help="in-flight requests requeued after an engine "
+                         "fault").inc()
+                requeued += 1
+            else:
+                self._finish(req, "error", RequestState.FINISHED, now)
+                errored += 1
+        logger.warning(
+            "serving engine fault (%s): requeued %d, errored %d of the "
+            "in-flight batch", type(err).__name__, requeued, errored)
+        record_recovery("serving_requeue", requeued=requeued,
+                        errored=errored,
+                        error=f"{type(err).__name__}: {err}")
+
+    def _update_degraded(self) -> None:
+        """/healthz shows degraded (503) while fault-requeued requests
+        are still draining — the replica is alive and recovering, and a
+        balancer should route new traffic elsewhere until it is clean."""
+        draining = any(r.retries for r in self._running.values()) or \
+            any(r.retries for r in self.queue._q)
+        telemetry.registry.gauge(
+            "resilience/serving_degraded",
+            help="1 while engine-fault retries drain").set(
+                1.0 if draining else 0.0)
+        if self._http is not None:
+            self._http.set_degraded(
+                draining, reason="engine-fault retries draining")
 
     def _trace_lifecycle(self, req: Request, reason: str,
                          now: float) -> None:

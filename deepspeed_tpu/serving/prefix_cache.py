@@ -192,6 +192,47 @@ class PrefixCache:
             dropped += 1
         return dropped
 
+    def _free_subtree(self, node: _Node) -> int:
+        """Free every page below ``node`` (not ``node`` itself)."""
+        n = 0
+        for rec in node.partials.values():
+            self.allocator.free([rec[0]])
+            n += 1
+        node.partials.clear()
+        for child in node.children.values():
+            n += self._free_subtree(child)
+            self.allocator.free([child.block])
+            n += 1
+        node.children.clear()
+        return n
+
+    def invalidate(self, tokens: List[int]) -> int:
+        """Drop every cached page reachable through ``tokens``' first
+        chunk — the serving failure domain calls this when an engine
+        fault may have left a request's KV suspect. A corrupt prefix
+        page poisons every cached extension of it, so the whole subtree
+        goes (over-invalidation only costs recompute; serving stale KV
+        costs correctness). Returns pages dropped."""
+        self._clock += 1
+        dropped = 0
+        root = self._root
+        key = (tuple(tokens[:self.block_size])
+               if len(tokens) >= self.block_size else None)
+        child = root.children.get(key) if key is not None else None
+        if child is not None:
+            dropped += self._free_subtree(child)
+            self.allocator.free([child.block])
+            del root.children[key]
+            dropped += 1
+        for span in [s for s in list(root.partials)
+                     if len(s) <= len(tokens)
+                     and tuple(tokens[:len(s)]) == s]:
+            self.allocator.free([root.partials[span][0]])
+            del root.partials[span]
+            dropped += 1
+        self.pages_cached -= dropped
+        return dropped
+
     def evictable_pages(self) -> int:
         """Pages the cache could give back under arena pressure (all of
         them — eviction recurses leaf-inward)."""

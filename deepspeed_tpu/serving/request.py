@@ -59,6 +59,12 @@ class Request:
     # prefix-cache accounting
     cached_tokens: int = 0   # prompt tokens served from the prefix cache
 
+    #: engine-fault recovery accounting: times this request was requeued
+    #: after an engine step failed under it. The frontend's retry budget
+    #: caps it; an exhausted budget finishes the request with reason
+    #: ``"error"`` (streamed to the client, never a hang).
+    retries: int = 0
+
     _cancel: bool = field(default=False, repr=False)
 
     def cancel(self) -> None:

@@ -58,6 +58,10 @@ def analyze(dumps: List[Dict[str, Any]],
                            if e.get("kind") == "watchdog"]
         preempt_events = [e for e in doc.get("events", [])
                           if e.get("kind") == "preemption"]
+        fault_events = [e for e in doc.get("events", [])
+                        if e.get("kind") == "fault_injected"]
+        recovery_events = [e for e in doc.get("events", [])
+                           if e.get("kind") == "recovery"]
         hosts.append({
             "name": _host_name(doc, i),
             "reason": doc.get("reason"),
@@ -68,6 +72,8 @@ def analyze(dumps: List[Dict[str, Any]],
             "exception": doc.get("exception"),
             "watchdog": watchdog_events,
             "preemption": preempt_events,
+            "faults_injected": fault_events,
+            "recoveries": recovery_events,
             "storms": (doc.get("compile") or {}).get("storms", []),
             "compile_functions": (doc.get("compile") or {}).get(
                 "functions", {}),
@@ -149,6 +155,33 @@ def analyze(dumps: List[Dict[str, Any]],
                     alg_bytes / total_step_s / 1e9
             bandwidth.append(row)
 
+    # -- recovery timeline: every fault/recovery-shaped event across
+    # hosts in time order — the chaos-run audit trail (which faults
+    # fired, which recovery answered each, what is still open)
+    recovery_timeline = []
+    for i, doc in enumerate(dumps):
+        for e in doc.get("events", []):
+            if e.get("kind") in ("fault_injected", "recovery",
+                                 "ckpt_fallback", "serving_engine_fault",
+                                 "preemption"):
+                recovery_timeline.append({**e, "host": _host_name(doc, i)})
+    recovery_timeline.sort(key=lambda e: (e.get("ts", 0.0),
+                                          e.get("step") or 0))
+    n_faults = sum(len(h["faults_injected"]) for h in hosts)
+    n_recoveries = sum(len(h["recoveries"]) for h in hosts)
+
+    # -- crash-loop naming from agent heartbeats: a host whose launch
+    # agent is burning its rolling restart budget
+    crash_looping = []
+    for hb in heartbeats or []:
+        if hb.get("phase") in ("restart_backoff", "crash_loop"):
+            crash_looping.append(
+                {"host": hb.get("hostname"),
+                 "phase": hb.get("phase"),
+                 "restarts_in_window": hb.get("restarts_in_window"),
+                 "backoff_s": hb.get("backoff_s"),
+                 "rc": hb.get("rc")})
+
     # -- anomaly timeline across hosts
     timeline = []
     for i, doc in enumerate(dumps):
@@ -181,6 +214,12 @@ def analyze(dumps: List[Dict[str, Any]],
             verdict = (f"HANG on {h['name']}: step {ev.get('step')} "
                        f"({ev.get('label')}) missed the "
                        f"{ev.get('timeout_s')}s watchdog deadline")
+    elif crash_looping:
+        c = crash_looping[0]
+        verdict = (f"CRASH LOOP: host {c['host']} has burned "
+                   f"{c['restarts_in_window']} restarts of its rolling "
+                   f"budget (agent phase {c['phase']}, last rc "
+                   f"{c.get('rc')})")
     elif preempted:
         h = preempted[0]
         verdict = (f"PREEMPTED on {h['name']} at step {h['last_step']} "
@@ -207,7 +246,12 @@ def analyze(dumps: List[Dict[str, Any]],
 
     return {"hosts": hosts, "straggler": straggler, "stalled": stalled,
             "bandwidth": bandwidth, "anomalies": timeline,
-            "storms": storms, "world": world, "verdict": verdict}
+            "storms": storms, "world": world, "verdict": verdict,
+            "recovery_timeline": recovery_timeline,
+            "crash_looping": crash_looping,
+            "resilience": {"faults_injected": n_faults,
+                           "recoveries": n_recoveries,
+                           "unrecovered": max(0, n_faults - n_recoveries)}}
 
 
 def render(report: Dict[str, Any]) -> str:
@@ -281,6 +325,27 @@ def render(report: Dict[str, Any]) -> str:
                        f"{e.get('detail') or e.get('value') or ''}")
         if len(report["anomalies"]) > 50:
             out.append(f"  ... {len(report['anomalies']) - 50} more")
+    rt = report.get("recovery_timeline") or []
+    res = report.get("resilience") or {}
+    if rt or report.get("crash_looping"):
+        out.append("")
+        out.append(f"recovery timeline ({res.get('faults_injected', 0)} "
+                   f"faults injected, {res.get('recoveries', 0)} "
+                   f"recoveries, {res.get('unrecovered', 0)} unrecovered):")
+        for e in rt[:50]:
+            kind = e.get("kind", "?")
+            what = (e.get("spec") or e.get("recovery")
+                    or e.get("checkpoint_tag") or e.get("bad_tag")
+                    or e.get("error") or "")
+            out.append(f"  step {e.get('step')!s:>8} {e['host']:<24}"
+                       f"{kind:<22}{what}")
+        if len(rt) > 50:
+            out.append(f"  ... {len(rt) - 50} more")
+        for c in report.get("crash_looping") or []:
+            out.append(f"  CRASH-LOOPING: {c['host']} "
+                       f"({c['restarts_in_window']} restarts in window, "
+                       f"backoff {c.get('backoff_s')}s, phase "
+                       f"{c['phase']})")
     out.append("")
     return "\n".join(out)
 

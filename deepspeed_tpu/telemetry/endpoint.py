@@ -41,6 +41,10 @@ class MetricsServer:
             os.environ.get("DSTPU_HEARTBEAT_FILE")
         self.fresh_s = float(fresh_s)
         self._clock = clock
+        #: degraded flag (set by the serving failure domain while requeued
+        #: requests drain): /healthz answers 503 so a balancer stops
+        #: routing NEW traffic to a replica still recovering
+        self._degraded: Optional[str] = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -81,9 +85,19 @@ class MetricsServer:
         except Exception as e:                       # noqa: BLE001
             return 500, "text/plain", f"metrics error: {e}\n"
 
+    def set_degraded(self, degraded: bool, reason: Optional[str] = None
+                     ) -> None:
+        """Flip /healthz into (or out of) degraded 503. Used by the
+        serving frontend while engine-fault retries drain — the process
+        is alive (no restart wanted) but should be out of rotation."""
+        self._degraded = (reason or "degraded") if degraded else None
+
     def _healthz(self):
-        """200 when healthy; 503 when the heartbeat is stale or the
-        watchdog marked the process stalled."""
+        """200 when healthy; 503 when degraded, the heartbeat is stale,
+        or the watchdog marked the process stalled."""
+        if self._degraded is not None:
+            return 503, "application/json", json.dumps(
+                {"status": "degraded", "reason": self._degraded}) + "\n"
         if not self.heartbeat_file:
             return 200, "application/json", '{"status": "ok"}\n'
         try:

@@ -110,11 +110,11 @@ def test_run_elastic_restarts(devices):
             raise RuntimeError("transient")
         return "done"
 
-    assert run_elastic(train_fn, max_restarts=3) == "done"
+    assert run_elastic(train_fn, max_restarts=3, backoff_s=0) == "done"
     assert calls == [0, 1, 2]
     with pytest.raises(RuntimeError, match="after 1 restarts"):
         run_elastic(lambda a: (_ for _ in ()).throw(RuntimeError("x")),
-                    max_restarts=1)
+                    max_restarts=1, backoff_s=0)
 
 
 def test_nvme_perf_sweep(tmp_path):

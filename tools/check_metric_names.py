@@ -35,7 +35,7 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "comm", "compile", "dispatch", "mem", "overlap",
-               "roofline", "serving", "train")
+               "resilience", "roofline", "serving", "train")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
