@@ -35,6 +35,30 @@ def _peak_flops(device) -> float:
     return peak_flops(device)
 
 
+def _apply_bench_slo(config) -> None:
+    """DSTPU_BENCH_SLO=";"-separated objective strings (e.g.
+    ``train/mfu >= 0.3;train/step_time_ms:p95 <= 250``) arms the SLO
+    burn-rate engine for the bench run: objectives into the config's
+    ``slo`` block, metric history every step so short runs still
+    evaluate. No env → config untouched."""
+    spec = os.environ.get("DSTPU_BENCH_SLO")
+    if not spec:
+        return
+    config["slo"] = {"objectives":
+                     [s.strip() for s in spec.split(";") if s.strip()]}
+    config.setdefault("telemetry", {})["history_every"] = 1
+
+
+def _slo_extra(engine_or_frontend):
+    """SLO stamp for the BENCH JSON line — always present so trajectory
+    files stay uniform; zeros when no objectives were armed."""
+    slo = getattr(engine_or_frontend, "_slo", None)
+    if slo is None:
+        return {"objectives": 0, "evaluated": 0, "worst_burn": 0.0,
+                "breached": []}
+    return slo.summary()
+
+
 def _run_sub(cmd, timeout):
     """Run a sub-benchmark; return its last JSON line or an error record."""
     import subprocess
@@ -151,6 +175,7 @@ def moe_main(args) -> None:
             "DSTPU_BENCH_CE_MB", 256)) or None) if on_tpu else None,
         "steps_per_print": 1000,
     }
+    _apply_bench_slo(config)
     engine, *_ = ds.initialize(model=model, config=config,
                                rng=jax.random.PRNGKey(0))
     gb = int(engine.config.train_batch_size)
@@ -184,7 +209,8 @@ def moe_main(args) -> None:
                   "params_active_b": round(active / 1e9, 3),
                   "loss": loss_val, "platform": dev0.platform,
                   "n_devices": n_dev, "steps": steps,
-                  "global_batch": gb}}
+                  "global_batch": gb,
+                  "slo": _slo_extra(engine)}}
     try:
         from deepspeed_tpu.telemetry import explain as _explain
         rep = _explain.explain_engine(
@@ -506,6 +532,7 @@ def main() -> None:
         "attention_impl": os.environ.get("DSTPU_BENCH_ATTN", "auto"),
         "steps_per_print": 1000,
     }
+    _apply_bench_slo(config)
     # DSTPU_BENCH_OFFLOAD=cpu|cpu_overlap|zenflow: measure the ZeRO-Offload
     # host-optimizer step (sync / overlapped / ZenFlow selective) against
     # the device step (the VERDICT r1 #6 'measure and report both' criterion)
@@ -575,6 +602,7 @@ def main() -> None:
             "n_devices": n_dev,
             "steps": steps,
             "global_batch": gb,
+            "slo": _slo_extra(engine),
         },
     }
     # compile-time roofline stamp (telemetry/explain): predicted FLOPs /

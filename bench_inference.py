@@ -15,6 +15,7 @@ Prints ONE JSON line.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -42,6 +43,32 @@ def _roofline_extra(eng) -> dict:
         } for lbl in ("prefill", "decode") if not recs[lbl].get("error")}
     except Exception:
         return {}
+
+
+def _slo_extra() -> dict:
+    """SLO stamp for the BENCH JSON line. DSTPU_BENCH_SLO=";"-separated
+    objective strings (e.g. ``serving/ttft_seconds:p95 <= 0.5``) arms a
+    one-shot evaluation: the final registry state is flushed through an
+    in-memory metric history and judged by the burn-rate engine. Always
+    returns a stamp (zeros when unarmed) so trajectory files stay
+    uniform; never breaks the headline JSON."""
+    spec = os.environ.get("DSTPU_BENCH_SLO")
+    if not spec:
+        return {"objectives": 0, "evaluated": 0, "worst_burn": 0.0,
+                "breached": []}
+    try:
+        from deepspeed_tpu.telemetry.registry import registry
+        from deepspeed_tpu.telemetry.slo import engine_from_config
+        from deepspeed_tpu.telemetry.timeseries import MetricHistory
+        hist = MetricHistory()                       # memory-only
+        slo = engine_from_config({"objectives": [
+            s.strip() for s in spec.split(";") if s.strip()]})
+        slo.publish = False
+        hist.subscribe(slo.observe)
+        registry.flush_to_monitor(None, 0, history=hist)
+        return slo.summary()
+    except Exception as e:               # noqa: BLE001
+        return {"error": str(e)[:200]}
 
 
 def bench_shared_prefix(args) -> None:
@@ -125,6 +152,7 @@ def bench_shared_prefix(args) -> None:
                 fe_cold.metrics.counters["engine_steps"],
             "ttft_mean_s": round(fe_hot.metrics.ttft.mean, 4),
             "roofline": _roofline_extra(eng),
+            "slo": _slo_extra(),
         },
     }
     print(json.dumps(result))
@@ -361,6 +389,7 @@ def main() -> None:
                     t_padded_uni / uni * 1e3, 2),
             },
             "roofline": _roofline_extra(v2),
+            "slo": _slo_extra(),
         },
     }
     if megastep_extra is not None:

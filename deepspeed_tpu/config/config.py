@@ -426,6 +426,50 @@ class TelemetryConfig(TPUConfigModel):
     #: override the per-chip peak HBM bytes/s used for the roofline
     #: memory bound (0/None → auto from the device kind)
     peak_hbm_bw_override: Optional[float] = Field(default=None, gt=0)
+    #: append every registry flush to this per-host metric-history JSONL
+    #: (telemetry/timeseries.py; None → no history file, though an
+    #: in-memory history still backs any declared SLO objectives)
+    history_file: Optional[str] = None
+    #: rotate (downsample the oldest half) when the history file would
+    #: exceed this many bytes
+    history_max_bytes: int = Field(default=8_388_608, ge=4096)
+    #: keep every Nth record of the oldest half on rotation
+    history_downsample: int = Field(default=2, ge=2)
+    #: flush history every N steps (0 → follow ``steps_per_print`` in the
+    #: engine; the serving frontend defaults to every 10 engine steps)
+    history_every: int = Field(default=0, ge=0)
+
+
+class SLOConfig(TPUConfigModel):
+    """``"slo"`` block → telemetry/slo.py (burn-rate objectives).
+
+    Objectives are ``"<metric>[:field] <op> <target>"`` strings (or
+    dicts with per-objective overrides), e.g.
+    ``"serving/ttft_seconds:p95 <= 0.5"`` or ``"train/mfu >= 0.3"``.
+    Declaring any objective turns continuous evaluation on wherever the
+    metric history flows (engine + serving frontend): burn gauges under
+    ``slo/*``, /healthz 503 naming the objective, flight-recorder
+    events, doctor verdicts. See docs/observability.md "Metric history
+    & SLOs"."""
+    objectives: List[Union[str, Dict[str, Any]]] = Field(
+        default_factory=list)
+    #: error budget: tolerated bad fraction of evaluations (0.01 = 1%)
+    budget: float = Field(default=0.01, gt=0, le=1)
+    #: fast alert window (catches the cliff)
+    fast_window_s: float = Field(default=60.0, gt=0)
+    #: slow alert window (suppresses blips); must exceed fast_window_s
+    slow_window_s: float = Field(default=600.0, gt=0)
+    #: breach when BOTH windows burn budget at ≥ this multiple of the
+    #: sustainable rate
+    burn_threshold: float = Field(default=2.0, gt=0)
+
+    @model_validator(mode="after")
+    def _windows_ordered(self):
+        if self.fast_window_s >= self.slow_window_s:
+            raise ValueError(
+                f"slo.fast_window_s ({self.fast_window_s}) must be "
+                f"shorter than slo.slow_window_s ({self.slow_window_s})")
+        return self
 
 
 class ServingConfig(TPUConfigModel):
@@ -592,6 +636,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    slo: SLOConfig = Field(default_factory=SLOConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)

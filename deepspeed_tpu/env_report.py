@@ -158,7 +158,22 @@ def main(argv=None) -> int:
                         help="trial-build any native op not yet cached")
     parser.add_argument("--no-device", action="store_true",
                         help="skip device probing (no jax backend init)")
+    parser.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                        help="regression gate: compare two runs' BENCH "
+                             "JSONL or metric-history files (baseline A "
+                             "vs candidate B); exit 1 on a regression "
+                             "beyond the noise band")
+    parser.add_argument("--noise", type=float, default=0.05,
+                        help="relative noise band for --compare "
+                             "(default %(default)s)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the --compare report as JSON")
     args = parser.parse_args(argv)
+
+    if args.compare:
+        from deepspeed_tpu.telemetry.compare import main_compare
+        return main_compare(args.compare[0], args.compare[1],
+                            noise=args.noise, as_json=args.json)
 
     version_report()
     ok = op_report(build=args.build)

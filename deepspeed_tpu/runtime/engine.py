@@ -1151,6 +1151,32 @@ class DeepSpeedTPUEngine:
             except Exception as e:                   # noqa: BLE001
                 logger.warning(
                     f"metrics endpoint on :{tcfg.http_port} failed: {e}")
+        # -- metric history + SLO burn-rate engine: a history_file key or
+        # any slo.objectives turns continuous evaluation on (the history
+        # runs memory-only when no file is configured); the SLO engine
+        # subscribes to history appends, so one registry snapshot per
+        # flush feeds the file, the burn gauges, /healthz, and the
+        # flight recorder together
+        self._metric_history = None
+        self._slo = None
+        scfg = getattr(self.config, "slo", None)
+        if tcfg.history_file or (scfg is not None and scfg.objectives):
+            from deepspeed_tpu.telemetry.slo import engine_from_config
+            from deepspeed_tpu.telemetry.timeseries import MetricHistory
+            try:
+                self._metric_history = MetricHistory(
+                    path=tcfg.history_file,
+                    max_bytes=tcfg.history_max_bytes,
+                    downsample=tcfg.history_downsample)
+                self._slo = engine_from_config(
+                    scfg, healthz=self._metrics_server)
+                if self._slo is not None:
+                    self._metric_history.subscribe(self._slo.observe)
+                    log_dist(f"SLO engine armed: "
+                             f"{[o.describe() for o in self._slo.objectives]}")
+            except Exception as e:                   # noqa: BLE001
+                logger.warning(f"metric history/SLO init failed: {e}")
+                self._metric_history = self._slo = None
 
     def _record_step_telemetry(self, dt_s: float) -> None:
         """Per-step registry metrics (always on — the registry is cheap).
@@ -1197,6 +1223,16 @@ class DeepSpeedTPUEngine:
         if self._mem_sampler is not None and \
                 self.global_steps % max(1, self.config.steps_per_print) == 0:
             self._mem_sampler.sample()
+        # metric history: when the monitor is enabled the history rides
+        # _flush_monitor's registry pass; without one (the common case)
+        # feed it here on its own cadence so SLOs still evaluate
+        if self._metric_history is not None and \
+                (self.monitor is None or not self.monitor.enabled):
+            every = getattr(self.config.telemetry, "history_every", 0) or \
+                max(1, self.config.steps_per_print)
+            if self.global_steps % max(1, every) == 0:
+                telemetry.registry.flush_to_monitor(
+                    None, self.global_steps, history=self._metric_history)
         # flight recorder: one dict append; loss/grad_norm/loss_scale stay
         # DEVICE scalars until a dump resolves them (no pipeline stall)
         m = getattr(self, "_last_metrics", None) or {}
@@ -1280,8 +1316,10 @@ class DeepSpeedTPUEngine:
                 loss=vals.get("loss"),
                 grad_norm=vals.get("grad_norm"))
         # registry snapshot rides the same flush cadence (MFU, step-time
-        # histogram aggregates, mem/* watermarks, comm/* counters)
-        telemetry.registry.flush_to_monitor(self.monitor, self.global_steps)
+        # histogram aggregates, mem/* watermarks, comm/* counters); the
+        # metric history + SLO evaluation share the same single lock pass
+        telemetry.registry.flush_to_monitor(self.monitor, self.global_steps,
+                                            history=self._metric_history)
 
     # ------------------------------------------------------------ utilities
 

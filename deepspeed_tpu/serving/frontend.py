@@ -161,6 +161,44 @@ class ServingFrontend:
         if http_port is not None:
             from deepspeed_tpu.telemetry.endpoint import MetricsServer
             self._http = MetricsServer(http_port)
+        # metric history + SLO burn-rate engine, same seam as the
+        # training engine's (_init_telemetry): a telemetry.history_file
+        # key or any slo.objectives turns continuous evaluation on;
+        # breaches flip this frontend's /healthz (source="slo") next to
+        # the fault-domain draining flag (source="serving")
+        self._history = None
+        self._slo = None
+        self._history_every = 10
+        if config is not None:
+            tcfg = (config.get("telemetry") if isinstance(config, dict)
+                    else getattr(config, "telemetry", None))
+            scfg = (config.get("slo") if isinstance(config, dict)
+                    else getattr(config, "slo", None))
+            tget = ((tcfg or {}).get if isinstance(tcfg, dict)
+                    else lambda k, d=None: getattr(tcfg, k, d))
+            hist_file = tget("history_file") if tcfg is not None else None
+            objectives = []
+            if scfg is not None:
+                objectives = (scfg.get("objectives") if isinstance(
+                    scfg, dict) else getattr(scfg, "objectives", None)) or []
+            if hist_file or objectives:
+                from deepspeed_tpu.telemetry.slo import engine_from_config
+                from deepspeed_tpu.telemetry.timeseries import MetricHistory
+                try:
+                    self._history = MetricHistory(
+                        path=hist_file,
+                        max_bytes=tget("history_max_bytes", 8_388_608),
+                        downsample=tget("history_downsample", 2))
+                    self._history_every = max(
+                        1, int(tget("history_every", 0) or 10))
+                    self._slo = engine_from_config(scfg, healthz=self._http)
+                    if self._slo is not None:
+                        self._history.subscribe(self._slo.observe)
+                except Exception as e:               # noqa: BLE001
+                    from deepspeed_tpu.utils.logging import logger
+                    logger.warning(
+                        f"serving metric history/SLO init failed: {e}")
+                    self._history = self._slo = None
 
     def close(self) -> None:
         """Release frontend-owned resources (the /metrics server);
@@ -434,6 +472,15 @@ class ServingFrontend:
         if self.emit_every and self.metrics.counters["engine_steps"] % \
                 self.emit_every == 0:
             self.emit_metrics()
+        # metric history + SLO evaluation on its own cadence: one
+        # registry snapshot feeds the history file, the slo/* burn
+        # gauges, /healthz, and the flight recorder together
+        if self._history is not None and \
+                self.metrics.counters["engine_steps"] % \
+                self._history_every == 0:
+            telemetry.registry.flush_to_monitor(
+                None, self.metrics.counters["engine_steps"],
+                history=self._history)
         # re-evaluate AFTER fan-out: the step that finishes the last
         # retried request must flip /healthz back to healthy — no later
         # pump is guaranteed once the replica drains idle
@@ -620,4 +667,6 @@ class ServingFrontend:
         if self.cache is not None:
             out["prefix_hit_rate"] = self.cache.hit_rate
             out["prefix_pages_cached"] = self.cache.pages_cached
+        if self._slo is not None:
+            out["slo"] = self._slo.summary()
         return out

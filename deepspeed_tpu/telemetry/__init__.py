@@ -40,6 +40,21 @@ to spend its FLOPs, bytes, and HBM" before it runs:
 - :mod:`~deepspeed_tpu.telemetry.endpoint` — the live scrape server
   (``GET /metrics`` + ``GET /healthz``), ``telemetry.http_port`` config.
 
+The time axis over all of it (PR 9):
+
+- :mod:`~deepspeed_tpu.telemetry.timeseries` — durable per-host metric
+  history (JSONL ring, size-bounded rotation + downsampling) recording
+  every registry flush, with a range/rate/windowed query API;
+- :mod:`~deepspeed_tpu.telemetry.slo` — config-declared objectives
+  (``slo.objectives``) evaluated continuously with fast/slow
+  multi-window burn-rate alerting (``slo/*`` gauges, /healthz 503,
+  flight-recorder events, doctor verdicts);
+- :mod:`~deepspeed_tpu.telemetry.fleet` — the ``dstpu-top`` live
+  terminal fleet view over N /metrics + /healthz endpoints (or history
+  files offline);
+- :mod:`~deepspeed_tpu.telemetry.compare` — the ``dstpu_report
+  --compare`` run-regression gate over BENCH JSONL / history files.
+
 See docs/observability.md for the config reference, the trace-capture
 workflow, the metric-name catalog, and post-mortem debugging.
 """
@@ -62,6 +77,12 @@ from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               Histogram, MetricsRegistry,
                                               registry)
+from deepspeed_tpu.telemetry.slo import (Objective, SLOEngine,  # noqa: F401
+                                         engine_from_config,
+                                         evaluate_history)
+from deepspeed_tpu.telemetry.timeseries import (MetricHistory,  # noqa: F401
+                                                load_records, merge_records,
+                                                resolve_metric, windowed)
 from deepspeed_tpu.telemetry.sampler import (MemorySampler,  # noqa: F401
                                              device_memory_stats,
                                              host_rss_bytes, mfu,
@@ -77,7 +98,10 @@ __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "anomaly_detector", "AnomalyDetector", "first_flagged_path",
            "ExplainReport", "FunctionCost", "Roofline", "analyze_fn",
            "explain_engine", "explain_serving", "normalize_cost_analysis",
-           "publish_gauges", "render", "resolve_peaks", "MetricsServer"]
+           "publish_gauges", "render", "resolve_peaks", "MetricsServer",
+           "MetricHistory", "load_records", "merge_records",
+           "resolve_metric", "windowed", "Objective", "SLOEngine",
+           "engine_from_config", "evaluate_history"]
 
 
 def configure(telemetry_config) -> None:

@@ -62,6 +62,8 @@ def analyze(dumps: List[Dict[str, Any]],
                         if e.get("kind") == "fault_injected"]
         recovery_events = [e for e in doc.get("events", [])
                            if e.get("kind") == "recovery"]
+        slo_events = [e for e in doc.get("events", [])
+                      if e.get("kind") in ("slo_breach", "slo_recovered")]
         hosts.append({
             "name": _host_name(doc, i),
             "reason": doc.get("reason"),
@@ -77,6 +79,7 @@ def analyze(dumps: List[Dict[str, Any]],
             "storms": (doc.get("compile") or {}).get("storms", []),
             "compile_functions": (doc.get("compile") or {}).get(
                 "functions", {}),
+            "slo_events": slo_events,
         })
         # predicted vs achieved: when the black box carries an explain
         # snapshot (telemetry/explain.py), compare its roofline
@@ -182,6 +185,21 @@ def analyze(dumps: List[Dict[str, Any]],
                  "backoff_s": hb.get("backoff_s"),
                  "rc": hb.get("rc")})
 
+    # -- SLO breach timeline: breach/recovery transitions recorded by
+    # the burn-rate engine (telemetry/slo.py); an objective whose latest
+    # transition on some host is a breach is still OPEN there
+    slo_timeline = []
+    for i, doc in enumerate(dumps):
+        for e in doc.get("events", []):
+            if e.get("kind") in ("slo_breach", "slo_recovered"):
+                slo_timeline.append({**e, "host": _host_name(doc, i)})
+    slo_timeline.sort(key=lambda e: (e.get("ts", 0.0), e.get("step") or 0))
+    latest_slo: Dict[Any, Dict[str, Any]] = {}
+    for e in slo_timeline:
+        latest_slo[(e["host"], e.get("objective"))] = e
+    slo_open = [e for e in latest_slo.values()
+                if e.get("kind") == "slo_breach"]
+
     # -- anomaly timeline across hosts
     timeline = []
     for i, doc in enumerate(dumps):
@@ -229,6 +247,13 @@ def analyze(dumps: List[Dict[str, Any]],
         e = nonfinite[0]
         verdict = (f"NON-FINITE values from step {e.get('step')} on "
                    f"{e['host']}: {e.get('detail') or e.get('anomaly')}")
+    elif slo_open:
+        e = slo_open[0]
+        verdict = (f"SLO BREACH on {e['host']}: objective "
+                   f"{e.get('objective')} ({e.get('metric')} "
+                   f"{e.get('op')} {e.get('target')}) still burning at "
+                   f"{e.get('burn_fast')}x budget "
+                   f"(last value {e.get('value')})")
     elif straggler and straggler["significant"]:
         verdict = (f"STRAGGLER: {straggler['host']} runs "
                    f"{straggler['skew']:.2f}x slower than the fastest "
@@ -237,6 +262,12 @@ def analyze(dumps: List[Dict[str, Any]],
     elif storms:
         verdict = (f"RECOMPILATION STORM: {', '.join(storms)} — check "
                    f"for drifting shapes or out-of-bucket requests")
+    elif slo_timeline:
+        n_br = len([e for e in slo_timeline if e["kind"] == "slo_breach"])
+        verdict = (f"SLO BREACHED AND RECOVERED: {n_br} breach(es) over "
+                   f"the run, all recovered (first: "
+                   f"{slo_timeline[0].get('objective')} at step "
+                   f"{slo_timeline[0].get('step')})")
     elif timeline:
         verdict = (f"COMPLETED WITH ANOMALIES: {len(timeline)} flagged "
                    f"(first: {timeline[0].get('anomaly')} at step "
@@ -247,6 +278,7 @@ def analyze(dumps: List[Dict[str, Any]],
     return {"hosts": hosts, "straggler": straggler, "stalled": stalled,
             "bandwidth": bandwidth, "anomalies": timeline,
             "storms": storms, "world": world, "verdict": verdict,
+            "slo": {"timeline": slo_timeline, "open": slo_open},
             "recovery_timeline": recovery_timeline,
             "crash_looping": crash_looping,
             "resilience": {"faults_injected": n_faults,
@@ -313,6 +345,19 @@ def render(report: Dict[str, Any]) -> str:
             out.append(f"  {h['name']:<24}predicted "
                        f"{r['predicted_ms']:.2f} ms "
                        f"({r.get('bound')}-bound) — {pct}")
+    slo = report.get("slo") or {}
+    if slo.get("timeline"):
+        out.append("")
+        n_open = len(slo.get("open") or [])
+        out.append(f"SLO transitions ({n_open} still open):")
+        for e in slo["timeline"][:50]:
+            state = "BREACH" if e["kind"] == "slo_breach" else "recovered"
+            out.append(f"  {e['host']:<24}{state:<10}"
+                       f"{e.get('objective', '?'):<32}"
+                       f"value={e.get('value')} "
+                       f"burn={e.get('burn_fast')}x")
+        if len(slo["timeline"]) > 50:
+            out.append(f"  ... {len(slo['timeline']) - 50} more")
     if report["storms"]:
         out.append("")
         out.append(f"recompile storms: {', '.join(report['storms'])}")

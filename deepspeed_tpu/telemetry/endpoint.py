@@ -22,7 +22,7 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -41,10 +41,12 @@ class MetricsServer:
             os.environ.get("DSTPU_HEARTBEAT_FILE")
         self.fresh_s = float(fresh_s)
         self._clock = clock
-        #: degraded flag (set by the serving failure domain while requeued
-        #: requests drain): /healthz answers 503 so a balancer stops
-        #: routing NEW traffic to a replica still recovering
-        self._degraded: Optional[str] = None
+        #: degraded reasons keyed by source — the serving failure domain
+        #: (while requeued requests drain) and the SLO burn-rate engine
+        #: flip this independently: /healthz answers 503 while ANY source
+        #: holds it, so a balancer stops routing NEW traffic to a replica
+        #: that is still recovering or blowing its error budget
+        self._degraded: Dict[str, str] = {}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,19 +87,27 @@ class MetricsServer:
         except Exception as e:                       # noqa: BLE001
             return 500, "text/plain", f"metrics error: {e}\n"
 
-    def set_degraded(self, degraded: bool, reason: Optional[str] = None
-                     ) -> None:
-        """Flip /healthz into (or out of) degraded 503. Used by the
-        serving frontend while engine-fault retries drain — the process
-        is alive (no restart wanted) but should be out of rotation."""
-        self._degraded = (reason or "degraded") if degraded else None
+    def set_degraded(self, degraded: bool, reason: Optional[str] = None,
+                     source: str = "serving") -> None:
+        """Flip /healthz into (or out of) degraded 503 for one
+        ``source`` (e.g. ``"serving"`` while engine-fault retries drain,
+        ``"slo"`` while an objective burns) — the process is alive (no
+        restart wanted) but should be out of rotation. Clearing one
+        source leaves the others' degradation standing."""
+        if degraded:
+            self._degraded[source] = reason or "degraded"
+        else:
+            self._degraded.pop(source, None)
 
     def _healthz(self):
         """200 when healthy; 503 when degraded, the heartbeat is stale,
         or the watchdog marked the process stalled."""
-        if self._degraded is not None:
+        if self._degraded:
             return 503, "application/json", json.dumps(
-                {"status": "degraded", "reason": self._degraded}) + "\n"
+                {"status": "degraded",
+                 "reason": "; ".join(self._degraded[k]
+                                     for k in sorted(self._degraded))}
+            ) + "\n"
         if not self.heartbeat_file:
             return 200, "application/json", '{"status": "ok"}\n'
         try:

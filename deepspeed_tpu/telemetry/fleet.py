@@ -1,0 +1,361 @@
+"""``dstpu-top`` — live terminal fleet view over N hosts' telemetry.
+
+Two sources, one table:
+
+- **live**: poll each target's ``GET /metrics`` (Prometheus text) and
+  ``GET /healthz`` (JSON) — the endpoints every engine / serving
+  frontend already serves (``telemetry.http_port``). Rates and interval
+  percentiles come from successive polls (cumulative counter / bucket
+  deltas), so the table shows what happened since the last refresh, not
+  all-time averages.
+- **offline** (``--history a.jsonl b.jsonl``): tail per-host metric
+  history files (:mod:`~deepspeed_tpu.telemetry.timeseries`) — same
+  table from a dead run's artifacts, no sockets. Useful in post-mortems
+  and in tests (``--once`` renders one frame and exits).
+
+Columns per host: health status, step, step rate, MFU, queue depth,
+TTFT p95 / TPOT p99 (interval), token throughput, worst SLO burn, and
+staleness (seconds since the host last reported). Fleet aggregates are
+republished as ``fleet/*`` gauges in the local registry so a
+supervising process can scrape its own ``/metrics`` for
+``fleet/hosts_degraded`` and alert on the aggregate.
+
+Usage::
+
+    dstpu-top host-a:9090 host-b:9090          # live, refresh loop
+    dstpu-top --once --json host-a:9090        # one machine-readable poll
+    dstpu-top --once --history /tmp/h*.jsonl   # offline post-mortem view
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.telemetry.registry import (percentile_from_counts,
+                                              registry)
+from deepspeed_tpu.telemetry.timeseries import load_records, resolve_metric
+
+DEFAULT_INTERVAL_S = 2.0
+DEFAULT_TIMEOUT_S = 2.0
+
+#: prometheus-flattened metric names the table reads (registry names
+#: with ``/`` → ``_``, see MetricsRegistry.prometheus_text)
+STEP_COUNTERS = ("train_steps", "serving_engine_steps")
+TOKEN_COUNTERS = ("serving_tokens_out", "train_tokens")
+MFU_GAUGES = ("train_mfu", "roofline_step_mfu")
+QUEUE_GAUGES = ("serving_queue_depth", "serving_queue_depth_mean")
+BURN_GAUGES = ("slo_worst_burn",)
+
+#: history-record (un-flattened) names for offline mode
+H_STEP = ("train/steps", "serving/engine_steps")
+H_TOKENS = ("serving/tokens_out", "train/tokens")
+H_MFU = ("train/mfu", "roofline/step_mfu")
+H_QUEUE = ("serving/queue_depth:mean", "serving/queue_depth")
+H_BURN = ("slo/worst_burn",)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Any]:
+    """Prometheus text exposition → ``{flat_name: float}`` for scalars
+    plus ``{name: {"buckets": [(le, cum), ...], "sum": s, "count": n}}``
+    for histograms. Tolerates unknown lines (forward compatible)."""
+    out: Dict[str, Any] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+            fval = float(val)
+        except ValueError:
+            continue
+        if key.endswith("}") and '_bucket{le="' in key:
+            name, le = key[:-2].split('_bucket{le="', 1)
+            h = hists.setdefault(name, {"buckets": [], "sum": 0.0,
+                                        "count": 0.0})
+            h["buckets"].append((float("inf") if le == "+Inf"
+                                 else float(le), fval))
+        elif key.endswith("_sum") and key[:-4] in hists:
+            hists[key[:-4]]["sum"] = fval
+        elif key.endswith("_count") and key[:-6] in hists:
+            hists[key[:-6]]["count"] = fval
+        elif "{" not in key:
+            out[key] = fval
+    out.update(hists)
+    return out
+
+
+def hist_percentile(h: Dict[str, Any], p: float,
+                    prev: Optional[Dict[str, Any]] = None
+                    ) -> Optional[float]:
+    """Percentile from parsed exposition buckets; when ``prev`` (the
+    previous poll of the same histogram) is given and compatible, judge
+    only the samples recorded between the two polls."""
+    buckets = sorted(h.get("buckets", []))
+    if not buckets:
+        return None
+    cum = [c for _, c in buckets]
+    if prev is not None:
+        pb = sorted(prev.get("buckets", []))
+        if len(pb) == len(buckets) and \
+                all(abs(a[0] - b[0]) < 1e-12 or (a[0] == b[0])
+                    for a, b in zip(pb, buckets)):
+            pc = [c for _, c in pb]
+            if all(c >= q for c, q in zip(cum, pc)):
+                cum = [c - q for c, q in zip(cum, pc)]
+    counts = [cum[0]] + [cum[i] - cum[i - 1] for i in range(1, len(cum))]
+    total = cum[-1]
+    if total <= 0:
+        return None
+    bounds = [le for le, _ in buckets if le != float("inf")]
+    return percentile_from_counts(bounds, counts, int(total), p,
+                                  vmax=bounds[-1] if bounds else None)
+
+
+def _first(d: Dict[str, Any], names) -> Optional[float]:
+    for n in names:
+        v = d.get(n)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+class HostSample:
+    """One poll of one host, plus derivatives vs the previous poll."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self.ts: Optional[float] = None
+        self.ok = False
+        self.status = "down"
+        self.reason = ""
+        self.metrics: Dict[str, Any] = {}
+        self.prev_metrics: Dict[str, Any] = {}
+        self.prev_ts: Optional[float] = None
+
+    def _rate(self, names) -> Optional[float]:
+        if self.prev_ts is None or self.ts is None or \
+                self.ts <= self.prev_ts:
+            return None
+        cur = _first(self.metrics, names)
+        prev = _first(self.prev_metrics, names)
+        if cur is None or prev is None or cur < prev:
+            return None
+        return (cur - prev) / (self.ts - self.prev_ts)
+
+    def row(self, now: float) -> Dict[str, Any]:
+        m = self.metrics
+        ttft = m.get("serving_ttft_seconds")
+        tpot = m.get("serving_tpot_seconds")
+        return {
+            "host": self.target,
+            "status": self.status,
+            "reason": self.reason,
+            "step": _first(m, STEP_COUNTERS),
+            "step_rate": self._rate(STEP_COUNTERS),
+            "mfu": _first(m, MFU_GAUGES),
+            "queue": _first(m, QUEUE_GAUGES),
+            "ttft_p95_ms": None if not isinstance(ttft, dict) else
+            _ms(hist_percentile(ttft, 95,
+                                self.prev_metrics.get(
+                                    "serving_ttft_seconds"))),
+            "tpot_p99_ms": None if not isinstance(tpot, dict) else
+            _ms(hist_percentile(tpot, 99,
+                                self.prev_metrics.get(
+                                    "serving_tpot_seconds"))),
+            "tok_rate": self._rate(TOKEN_COUNTERS),
+            "burn": _first(m, BURN_GAUGES),
+            "stale_s": None if self.ts is None else max(0.0, now - self.ts),
+        }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else v * 1000.0
+
+
+def _http_get(url: str, timeout: float) -> Tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:                   # 503 carries body
+        return e.code, e.read().decode("utf-8", "replace")
+
+
+def poll_host(sample: HostSample, timeout: float = DEFAULT_TIMEOUT_S,
+              clock=time.time) -> HostSample:
+    """Refresh one live host sample from /metrics + /healthz."""
+    base = sample.target if "://" in sample.target \
+        else f"http://{sample.target}"
+    sample.prev_metrics, sample.prev_ts = sample.metrics, sample.ts
+    try:
+        _, text = _http_get(f"{base}/metrics", timeout)
+        sample.metrics = parse_prometheus_text(text)
+        sample.ts = clock()
+        sample.ok = True
+    except Exception as e:                                # noqa: BLE001
+        sample.ok = False
+        sample.status, sample.reason = "down", str(e)
+        return sample
+    try:
+        code, body = _http_get(f"{base}/healthz", timeout)
+        doc = json.loads(body)
+        sample.status = doc.get("status", "ok" if code == 200 else "bad")
+        sample.reason = doc.get("reason", "")
+    except Exception as e:                                # noqa: BLE001
+        sample.status, sample.reason = "no_healthz", str(e)
+    return sample
+
+
+def rows_from_history(paths: List[str],
+                      clock=time.time) -> List[Dict[str, Any]]:
+    """Offline mode: one table row per host from history files (last
+    record per host; rates from the last two records)."""
+    by_host: Dict[str, List[Dict[str, Any]]] = {}
+    for p in paths:
+        for rec in load_records(p):
+            by_host.setdefault(rec.get("host", p), []).append(rec)
+    now = clock()
+    rows = []
+    for host, recs in sorted(by_host.items()):
+        recs.sort(key=lambda r: (r.get("ts", 0.0), r.get("step", 0)))
+        last = recs[-1]
+
+        def metric(names, prefer_interval=False, rec=last):
+            for n in names:
+                v = resolve_metric(rec, n, prefer_interval=prefer_interval)
+                if v is not None:
+                    return v
+            return None
+
+        def rate(names):
+            if len(recs) < 2:
+                return None
+            a, b = recs[-2], recs[-1]
+            dt = b.get("ts", 0.0) - a.get("ts", 0.0)
+            va, vb = metric(names, rec=a), metric(names, rec=b)
+            if dt <= 0 or va is None or vb is None or vb < va:
+                return None
+            return (vb - va) / dt
+
+        breached = metric(("slo/breached",))
+        rows.append({
+            "host": host,
+            "status": "degraded" if breached else "ok",
+            "reason": "slo breach" if breached else "",
+            "step": metric(H_STEP),
+            "step_rate": rate(H_STEP),
+            "mfu": metric(H_MFU),
+            "queue": metric(H_QUEUE),
+            "ttft_p95_ms": _ms(metric(("serving/ttft_seconds:p95",),
+                                      prefer_interval=True)),
+            "tpot_p99_ms": _ms(metric(("serving/tpot_seconds:p99",),
+                                      prefer_interval=True)),
+            "tok_rate": rate(H_TOKENS),
+            "burn": metric(H_BURN),
+            "stale_s": max(0.0, now - last.get("ts", now)),
+        })
+    return rows
+
+
+def publish_fleet_gauges(rows: List[Dict[str, Any]]) -> None:
+    """Republish fleet aggregates into the local registry so whoever
+    runs dstpu-top can itself be scraped."""
+    registry.gauge("fleet/hosts").set(float(len(rows)))
+    registry.gauge("fleet/hosts_degraded").set(
+        float(sum(1 for r in rows if r["status"] not in ("ok",))))
+    stales = [r["stale_s"] for r in rows if r["stale_s"] is not None]
+    registry.gauge("fleet/staleness_s_max").set(max(stales, default=0.0))
+    burns = [r["burn"] for r in rows if r["burn"] is not None]
+    registry.gauge("fleet/worst_burn").set(max(burns, default=0.0))
+
+
+_COLS = [
+    ("HOST", "host", "{}", 22),
+    ("STAT", "status", "{}", 9),
+    ("STEP", "step", "{:.0f}", 8),
+    ("STEP/S", "step_rate", "{:.2f}", 7),
+    ("MFU", "mfu", "{:.3f}", 6),
+    ("QUEUE", "queue", "{:.1f}", 6),
+    ("TTFT*", "ttft_p95_ms", "{:.1f}", 8),
+    ("TPOT*", "tpot_p99_ms", "{:.1f}", 8),
+    ("TOK/S", "tok_rate", "{:.1f}", 8),
+    ("BURN", "burn", "{:.2f}", 6),
+    ("STALE", "stale_s", "{:.0f}s", 6),
+]
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    """Fixed-width fleet table (``*`` columns are interval p95/p99 ms)."""
+    lines = [" ".join(h.ljust(w) for h, _, _, w in _COLS)]
+    for r in rows:
+        cells = []
+        for _, key, fmt, w in _COLS:
+            v = r.get(key)
+            cell = "-" if v is None else fmt.format(v)
+            cells.append(cell[:w].ljust(w))
+        lines.append(" ".join(cells))
+        if r.get("reason"):
+            lines.append(f"    └─ {r['reason']}")
+    degraded = sum(1 for r in rows if r["status"] not in ("ok",))
+    lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
+                 f"(* = interval percentile, ms)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-top",
+        description="live terminal fleet view over dstpu /metrics + "
+                    "/healthz endpoints, or offline over metric history "
+                    "files")
+    ap.add_argument("targets", nargs="*",
+                    help="host:port of /metrics endpoints to poll")
+    ap.add_argument("--history", nargs="+", default=None, metavar="FILE",
+                    help="offline mode: per-host metric history JSONL "
+                         "files instead of live endpoints")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI / tests)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit rows as JSON instead of the table")
+    ap.add_argument("--interval", type=float, default=DEFAULT_INTERVAL_S,
+                    help="refresh period, seconds (default %(default)s)")
+    ap.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT_S,
+                    help="per-request HTTP timeout, seconds")
+    args = ap.parse_args(argv)
+    if bool(args.targets) == bool(args.history):
+        ap.error("give either live targets or --history files (not both)")
+
+    samples = [HostSample(t) for t in args.targets]
+    first = True
+    while True:
+        if args.history:
+            rows = rows_from_history(args.history)
+        else:
+            now = time.time()
+            rows = [poll_host(s, timeout=args.timeout).row(now)
+                    for s in samples]
+        publish_fleet_gauges(rows)
+        if args.json:
+            out = json.dumps(rows, default=float)
+        else:
+            out = render_table(rows)
+        if not args.once and not first and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")          # clear + home
+        print(out)
+        if args.once:
+            degraded = sum(1 for r in rows
+                           if r["status"] not in ("ok",))
+            return 2 if degraded else 0
+        first = False
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

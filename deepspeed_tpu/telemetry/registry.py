@@ -20,7 +20,7 @@ import math
 import re
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 Event = Tuple[str, float, int]
 
@@ -42,6 +42,52 @@ def _fmt(v: float) -> str:
     if v == -math.inf:
         return "-Inf"
     return f"{float(v):.10g}"
+
+
+def percentile_from_counts(bounds: List[float], counts: List[int],
+                           total: float, p: float,
+                           vmin: Optional[float] = None,
+                           vmax: Optional[float] = None) -> float:
+    """Interpolated percentile over log-spaced bucket counts.
+
+    ``bounds[i]`` is bucket i's inclusive upper edge; ``counts`` may carry
+    one extra trailing overflow slot. Within a regular bucket the value is
+    placed log-linearly between the bucket's edges (the buckets are a
+    geometric ladder, so log interpolation is the natural inverse) instead
+    of snapping to the upper edge; the overflow bucket clamps to the
+    tracked ``vmax``. The result is always clamped into [vmin, vmax] —
+    exact extremes beat any interpolation the bucketing can offer.
+
+    Shared by :meth:`Histogram.percentile`, the registry's interval
+    snapshots, and the fleet view's Prometheus-scrape reconstruction.
+    """
+    if not total or total <= 0:
+        return 0.0
+    target = p / 100.0 * total
+    v: Optional[float] = None
+    acc = 0.0
+    for i, c in enumerate(counts):
+        acc += c
+        if c > 0 and acc >= target:
+            if i >= len(bounds):            # overflow bucket → exact max
+                v = vmax if vmax is not None else float(bounds[-1])
+            else:
+                upper = float(bounds[i])
+                lower = float(bounds[i - 1]) if i > 0 else (
+                    vmin if vmin is not None and 0 < vmin < upper else None)
+                if lower is None or lower <= 0 or upper <= lower:
+                    v = upper
+                else:
+                    f = (target - (acc - c)) / c
+                    v = lower * (upper / lower) ** f
+            break
+    if v is None:
+        v = vmax if vmax is not None else float(bounds[-1])
+    if vmin is not None:
+        v = max(v, vmin)
+    if vmax is not None:
+        v = min(v, vmax)
+    return v
 
 
 class Counter:
@@ -118,20 +164,14 @@ class Histogram:
         return self.total / self.count if self.count else 0.0
 
     def percentile(self, p: float) -> float:
-        """Upper bound of the bucket holding the p-th percentile sample
-        (the exact ``vmax`` for samples in the overflow bucket)."""
+        """p-th percentile, log-linearly interpolated within the bucket
+        holding it (clamped to the exact ``vmin``/``vmax`` extremes; the
+        overflow bucket reports ``vmax``) — SLO thresholds on p95/p99
+        aren't quantized to bucket edges."""
         if not self.count:
             return 0.0
-        target = p / 100.0 * self.count
-        acc = 0
-        for i, c in enumerate(self.counts):
-            acc += c
-            if acc >= target:
-                if i >= len(self.bounds):
-                    return self.vmax if self.vmax is not None \
-                        else self.bounds[-1]
-                return self.bounds[i]
-        return self.vmax if self.vmax is not None else self.bounds[-1]
+        return percentile_from_counts(self.bounds, self.counts, self.count,
+                                      p, vmin=self.vmin, vmax=self.vmax)
 
     def summary(self) -> Dict[str, float]:
         return {"count": self.count, "mean": self.mean,
@@ -155,6 +195,10 @@ class MetricsRegistry:
         self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
         self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
+        #: previous bucket counts per histogram, for the interval
+        #: summaries in :meth:`snapshot` (percentiles over the samples
+        #: since the LAST snapshot — what SLO burn windows judge)
+        self._hist_prev: Dict[str, Tuple[List[int], float, int]] = {}
 
     # -- registration -------------------------------------------------------
 
@@ -245,32 +289,98 @@ class MetricsRegistry:
                 lines.append(f"{pn}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
-    # -- monitor bridge -----------------------------------------------------
+    # -- monitor / history bridge -------------------------------------------
+
+    def snapshot(self, interval: bool = True
+                 ) -> Dict[str, Union[float, Dict[str, Any]]]:
+        """One-pass structured snapshot of every metric: counters/gauges
+        as floats, histograms as their summary dict extended with p90/p95
+        and (when ``interval``) an ``"interval"`` sub-summary over the
+        samples recorded since the previous ``snapshot(interval=True)``
+        call — all-time percentiles never recover after a bad patch, so
+        SLO windows judge the interval numbers.
+
+        This is the shared source for :meth:`flush_to_monitor`'s monitor
+        events AND the metric-history sink (one lock pass feeds both).
+        """
+        with self._lock:
+            items = list(self._metrics.items())
+        snap: Dict[str, Union[float, Dict[str, Any]]] = {}
+        for name, m in items:
+            if isinstance(m, (Counter, Gauge)):
+                snap[name] = float(m.value)
+            elif isinstance(m, Histogram) and m.count:
+                s: Dict[str, Any] = m.summary()
+                s["p90"] = m.percentile(90)
+                s["p95"] = m.percentile(95)
+                if interval:
+                    s["interval"] = self._interval_summary(name, m)
+                snap[name] = s
+        return snap
+
+    def _interval_summary(self, name: str, m: Histogram) -> Dict[str, Any]:
+        """Summary over the samples since the last snapshot: bucket-count
+        deltas against the stored previous counts (a replaced/reshaped
+        histogram resets the baseline)."""
+        counts, total, count = list(m.counts), m.total, m.count
+        prev = self._hist_prev.get(name)
+        self._hist_prev[name] = (counts, total, count)
+        if prev is None or len(prev[0]) != len(counts) or \
+                any(c < pc for c, pc in zip(counts, prev[0])):
+            dc, dtotal, dcount = counts, total, count
+        else:
+            dc = [c - pc for c, pc in zip(counts, prev[0])]
+            dtotal, dcount = total - prev[1], count - prev[2]
+        if dcount <= 0:
+            return {"count": 0}
+        return {
+            "count": dcount, "mean": dtotal / dcount,
+            "p50": percentile_from_counts(m.bounds, dc, dcount, 50,
+                                          vmax=m.vmax),
+            "p95": percentile_from_counts(m.bounds, dc, dcount, 95,
+                                          vmax=m.vmax),
+            "p99": percentile_from_counts(m.bounds, dc, dcount, 99,
+                                          vmax=m.vmax),
+        }
 
     def events(self, step: int = 0) -> List[Event]:
         """Snapshot as ``(name, value, step)`` monitor events. Histograms
         contribute mean/p99/count derived series (a TB scalar can't carry
         buckets)."""
-        with self._lock:
-            items = list(self._metrics.items())
+        return self._events_from(self.snapshot(interval=False), step)
+
+    @staticmethod
+    def _events_from(snap: Dict[str, Union[float, Dict[str, Any]]],
+                     step: int) -> List[Event]:
         ev: List[Event] = []
-        for name, m in items:
-            if isinstance(m, (Counter, Gauge)):
-                ev.append((name, float(m.value), step))
-            elif isinstance(m, Histogram) and m.count:
-                ev.append((f"{name}_mean", m.mean, step))
-                ev.append((f"{name}_p99", m.percentile(99), step))
-                ev.append((f"{name}_count", float(m.count), step))
+        for name, v in snap.items():
+            if isinstance(v, dict):
+                ev.append((f"{name}_mean", float(v["mean"]), step))
+                ev.append((f"{name}_p99", float(v["p99"]), step))
+                ev.append((f"{name}_count", float(v["count"]), step))
+            else:
+                ev.append((name, float(v), step))
         return ev
 
-    def flush_to_monitor(self, monitor, step: int = 0) -> None:
-        """Write a snapshot through a MonitorMaster (no-op when monitoring
-        is disabled or absent)."""
-        if monitor is None or not getattr(monitor, "enabled", False):
+    def flush_to_monitor(self, monitor, step: int = 0,
+                         history=None) -> None:
+        """Write a snapshot through a MonitorMaster and/or a metric-
+        history sink (:class:`~deepspeed_tpu.telemetry.timeseries.
+        MetricHistory`). One :meth:`snapshot` call feeds both — the
+        history record and the monitor events come from the same lock
+        pass. No-op when monitoring is disabled/absent and no history
+        sink is given."""
+        want_monitor = monitor is not None and \
+            getattr(monitor, "enabled", False)
+        if not want_monitor and history is None:
             return
-        ev = self.events(step)
-        if ev:
-            monitor.write_events(ev)
+        snap = self.snapshot(interval=history is not None)
+        if history is not None:
+            history.append(step, snap)
+        if want_monitor:
+            ev = self._events_from(snap, step)
+            if ev:
+                monitor.write_events(ev)
 
 
 #: process-wide registry (counterpart of the process-wide ``tracer``)

@@ -1,0 +1,313 @@
+"""SLO objectives + multi-window burn-rate alerting over metric history.
+
+Objectives are declared in config (``slo.objectives``), either as a
+compact string::
+
+    slo:
+      objectives:
+        - "serving/ttft_seconds:p95 <= 0.5"
+        - "serving/tpot_seconds:p99 <= 0.05"
+        - "train/step_time_ms:p95 <= 250"
+        - "train/mfu >= 0.30"
+
+or as a dict with per-objective overrides::
+
+        - metric: serving/ttft_seconds:p95
+          op: "<="
+          target: 0.5
+          budget: 0.01          # error budget: 1% of windows may be bad
+          burn_threshold: 2.0
+
+The metric grammar is :func:`~deepspeed_tpu.telemetry.timeseries
+.resolve_metric`'s — ``area/name`` or ``area/name:field`` — and
+histogram fields are judged on the INTERVAL summary (samples since the
+previous flush) when one is present, so a latency storm that ends
+actually shows recovery instead of being averaged into all-time
+percentiles forever.
+
+**Burn rate** is SRE arithmetic: over a trailing window, ``burn =
+bad_fraction / error_budget``. Burn 1.0 spends the budget exactly at
+sustainable pace; burn 10 exhausts a 30-day budget in 3 days. A breach
+requires BOTH the fast window (default 60s — catches the cliff) and the
+slow window (default 600s — suppresses blips) to exceed
+``burn_threshold``; recovery is the fast window dropping back under.
+This is the standard multi-window multi-burn-rate alert shape, sized
+down to single-run horizons.
+
+On every evaluation the engine publishes per-objective gauges
+(``slo/<name>/burn_fast``, ``slo/<name>/burn_slow``,
+``slo/<name>/breached``) plus aggregates (``slo/breached``,
+``slo/worst_burn``, ``slo/objectives``). Breach/recovery transitions
+are flight-recorded (``kind="slo_breach"`` / ``"slo_recovered"`` — the
+doctor ranks these into its verdict) and flip ``/healthz`` to degraded
+naming the objective (503 body: ``slo:<name> <metric> <op> <target>``).
+
+The engine subscribes to a :class:`~deepspeed_tpu.telemetry.timeseries
+.MetricHistory`, so SLOs are evaluated exactly as often as history is
+written — one registry lock pass feeds both.
+"""
+
+import re
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Union
+
+from deepspeed_tpu.telemetry.flight_recorder import flight_recorder
+from deepspeed_tpu.telemetry.registry import registry
+from deepspeed_tpu.telemetry.timeseries import Record, resolve_metric
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_BUDGET = 0.01
+DEFAULT_FAST_WINDOW_S = 60.0
+DEFAULT_SLOW_WINDOW_S = 600.0
+DEFAULT_BURN_THRESHOLD = 2.0
+
+_OPS = {
+    "<=": lambda v, t: v <= t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    ">": lambda v, t: v > t,
+}
+_SPEC = re.compile(r"^\s*(\S+)\s*(<=|>=|<|>)\s*([-+0-9.eE]+)\s*$")
+
+
+def _sanitize(metric: str) -> str:
+    """Lint-safe gauge-name segment for an objective: ``serving/
+    ttft_seconds:p95`` → ``serving_ttft_seconds_p95``."""
+    return re.sub(r"[^a-z0-9_]+", "_", metric.lower()).strip("_")
+
+
+class Objective:
+    """One declared SLO: ``<metric> <op> <target>`` plus alert tuning."""
+
+    def __init__(self, metric: str, op: str, target: float,
+                 name: Optional[str] = None,
+                 budget: float = DEFAULT_BUDGET,
+                 fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+                 slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD):
+        if op not in _OPS:
+            raise ValueError(f"unknown SLO op {op!r} (want one of "
+                             f"{sorted(_OPS)})")
+        if not (0 < budget <= 1):
+            raise ValueError(f"SLO budget must be in (0, 1], got {budget}")
+        if fast_window_s >= slow_window_s:
+            raise ValueError(
+                f"SLO fast window ({fast_window_s}s) must be shorter than "
+                f"the slow window ({slow_window_s}s)")
+        self.metric = metric
+        self.op = op
+        self.target = float(target)
+        self.name = name or _sanitize(metric)
+        self.budget = float(budget)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        # (ts, bad) observations, pruned to the slow window
+        self._obs: deque = deque()
+        self.breached = False
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+        self.last_value: Optional[float] = None
+
+    @classmethod
+    def parse(cls, spec: Union[str, Dict[str, Any]],
+              defaults: Optional[Dict[str, Any]] = None) -> "Objective":
+        """Build from the config grammar (string or dict form).
+        ``defaults`` supplies engine-level budget/window/threshold that a
+        dict spec may override per objective."""
+        defaults = defaults or {}
+        if isinstance(spec, str):
+            m = _SPEC.match(spec)
+            if not m:
+                raise ValueError(
+                    f"bad SLO objective {spec!r} (want "
+                    f"'<metric>[:field] <op> <target>', e.g. "
+                    f"'serving/ttft_seconds:p95 <= 0.5')")
+            return cls(m.group(1), m.group(2), float(m.group(3)),
+                       **defaults)
+        if isinstance(spec, dict):
+            kw = dict(defaults)
+            kw.update({k: spec[k] for k in
+                       ("name", "budget", "fast_window_s", "slow_window_s",
+                        "burn_threshold") if k in spec})
+            return cls(spec["metric"], spec.get("op", "<="),
+                       float(spec["target"]), **kw)
+        raise TypeError(f"SLO objective must be str or dict, got "
+                        f"{type(spec).__name__}")
+
+    def describe(self) -> str:
+        return f"{self.metric} {self.op} {self.target:g}"
+
+    def observe(self, record: Record, now: float) -> Optional[bool]:
+        """Judge one history record; returns the bad/good verdict, or
+        ``None`` when the record doesn't carry the metric (no samples
+        this interval ≠ a violation)."""
+        v = resolve_metric(record, self.metric, prefer_interval=True)
+        if v is None:
+            return None
+        self.last_value = v
+        bad = not _OPS[self.op](v, self.target)
+        self._obs.append((now, bad))
+        cutoff = now - self.slow_window_s
+        while self._obs and self._obs[0][0] < cutoff:
+            self._obs.popleft()
+        return bad
+
+    def burn(self, now: float) -> None:
+        """Recompute fast/slow burn rates and the breach state."""
+        fast_cut = now - self.fast_window_s
+        nf = bf = ns = bs = 0
+        for ts, bad in self._obs:
+            ns += 1
+            bs += bad
+            if ts >= fast_cut:
+                nf += 1
+                bf += bad
+        self.burn_fast = (bf / nf / self.budget) if nf else 0.0
+        self.burn_slow = (bs / ns / self.budget) if ns else 0.0
+        if not self.breached:
+            self.breached = (self.burn_fast >= self.burn_threshold and
+                             self.burn_slow >= self.burn_threshold)
+        else:
+            self.breached = self.burn_fast >= self.burn_threshold
+
+
+class SLOEngine:
+    """Evaluates objectives on each history record; publishes ``slo/*``
+    gauges and drives healthz / flight-recorder / doctor on transitions.
+
+    ``healthz`` is anything with ``set_degraded(flag, reason=...,
+    source=...)`` — in practice the :class:`MetricsServer`; ``publish``
+    =False runs side-effect-free (offline replay / tests).
+    """
+
+    def __init__(self, objectives: List[Union[str, Dict[str, Any]]],
+                 budget: float = DEFAULT_BUDGET,
+                 fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+                 slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+                 healthz=None, publish: bool = True, clock=time.time):
+        defaults = dict(budget=budget, fast_window_s=fast_window_s,
+                        slow_window_s=slow_window_s,
+                        burn_threshold=burn_threshold)
+        self.objectives = [Objective.parse(s, defaults) for s in objectives]
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO objective names: {names} "
+                             f"(set 'name:' on the dict form)")
+        self.healthz = healthz
+        self.publish = publish
+        self._clock = clock
+        self.evaluations = 0
+
+    # -- evaluation ---------------------------------------------------------
+
+    def observe(self, record: Record) -> None:
+        """History-subscriber entry point: judge every objective against
+        one record and emit all downstream effects."""
+        now = float(record.get("ts") or self._clock())
+        self.evaluations += 1
+        for obj in self.objectives:
+            was = obj.breached
+            obj.observe(record, now)
+            obj.burn(now)
+            if self.publish:
+                registry.gauge(f"slo/{obj.name}/burn_fast").set(
+                    obj.burn_fast)
+                registry.gauge(f"slo/{obj.name}/burn_slow").set(
+                    obj.burn_slow)
+                registry.gauge(f"slo/{obj.name}/breached").set(
+                    float(obj.breached))
+            if obj.breached != was:
+                self._transition(obj, now)
+        if self.publish:
+            registry.gauge("slo/objectives").set(float(len(self.objectives)))
+            registry.gauge("slo/breached").set(
+                float(sum(o.breached for o in self.objectives)))
+            registry.gauge("slo/worst_burn").set(self.worst_burn())
+        self._sync_healthz()
+
+    def _transition(self, obj: Objective, now: float) -> None:
+        kind = "slo_breach" if obj.breached else "slo_recovered"
+        detail = (f"objective {obj.name} ({obj.describe()}) "
+                  f"value={obj.last_value} burn_fast={obj.burn_fast:.2f} "
+                  f"burn_slow={obj.burn_slow:.2f}")
+        (logger.warning if obj.breached else logger.info)(
+            f"SLO {kind.split('_', 1)[1]}: {detail}")
+        if not self.publish:
+            return
+        flight_recorder.record_event(
+            kind, objective=obj.name, metric=obj.metric, op=obj.op,
+            target=obj.target, value=obj.last_value,
+            burn_fast=round(obj.burn_fast, 4),
+            burn_slow=round(obj.burn_slow, 4))
+
+    def _sync_healthz(self) -> None:
+        if self.healthz is None or not self.publish:
+            return
+        breached = [o for o in self.objectives if o.breached]
+        if breached:
+            reason = "; ".join(
+                f"slo:{o.name} {o.describe()} (burn {o.burn_fast:.1f}x)"
+                for o in breached)
+            self.healthz.set_degraded(True, reason=reason, source="slo")
+        else:
+            self.healthz.set_degraded(False, source="slo")
+
+    # -- reporting ----------------------------------------------------------
+
+    def worst_burn(self) -> float:
+        return max((max(o.burn_fast, o.burn_slow)
+                    for o in self.objectives), default=0.0)
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact state for bench stamps / ``stats()`` blocks."""
+        return {
+            "objectives": len(self.objectives),
+            "evaluated": self.evaluations,
+            "worst_burn": round(self.worst_burn(), 4),
+            "breached": [o.name for o in self.objectives if o.breached],
+        }
+
+
+def engine_from_config(slo_cfg, healthz=None,
+                       clock=time.time) -> Optional[SLOEngine]:
+    """Build an :class:`SLOEngine` from an ``slo:`` config block (pydantic
+    model or plain dict); ``None`` when no objectives are declared."""
+    if slo_cfg is None:
+        return None
+    get = (slo_cfg.get if isinstance(slo_cfg, dict)
+           else lambda k, d=None: getattr(slo_cfg, k, d))
+    objectives = get("objectives") or []
+    if not objectives:
+        return None
+    return SLOEngine(
+        objectives,
+        budget=get("budget", DEFAULT_BUDGET),
+        fast_window_s=get("fast_window_s", DEFAULT_FAST_WINDOW_S),
+        slow_window_s=get("slow_window_s", DEFAULT_SLOW_WINDOW_S),
+        burn_threshold=get("burn_threshold", DEFAULT_BURN_THRESHOLD),
+        healthz=healthz, clock=clock)
+
+
+def evaluate_history(records: List[Record], slo_cfg) -> Dict[str, Any]:
+    """Offline replay: run the burn-rate engine over loaded history
+    records with no side effects (no gauges, no healthz, no flight
+    recorder). Returns the final :meth:`SLOEngine.summary` plus
+    per-objective detail — what ``dstpu-report --compare`` consumes."""
+    eng = engine_from_config(slo_cfg)
+    if eng is None:
+        return {"objectives": 0, "evaluated": 0, "worst_burn": 0.0,
+                "breached": []}
+    eng.publish = False
+    for rec in records:
+        eng.observe(rec)
+    out = eng.summary()
+    out["detail"] = [
+        {"name": o.name, "objective": o.describe(),
+         "burn_fast": round(o.burn_fast, 4),
+         "burn_slow": round(o.burn_slow, 4),
+         "breached": o.breached, "last_value": o.last_value}
+        for o in eng.objectives]
+    return out

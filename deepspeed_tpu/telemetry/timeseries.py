@@ -1,0 +1,292 @@
+"""Durable per-host metric history: an append-only JSONL ring with
+size-bounded rotation, coarse downsampling, and a small query API.
+
+Every telemetry surface before this one was a point-in-time snapshot
+(``/metrics`` shows the current registry, the flight recorder keeps a
+bounded ring, the doctor speaks after a crash). This module gives the
+registry a time axis: every flush appends one JSON line per host::
+
+    {"ts": 1722947191.2, "step": 120, "host": "tpu-vm-3",
+     "m": {"train/mfu": 0.41, "train/steps": 120.0,
+           "serving/ttft_seconds": {"count": 64, "mean": 0.021,
+                                    "p50": 0.017, "p90": ..., "p95": ...,
+                                    "p99": ..., "min": ..., "max": ...,
+                                    "interval": {"count": 8, "p95": ...}}}}
+
+(the ``m`` dict is exactly :meth:`MetricsRegistry.snapshot` — counters/
+gauges as floats, histograms as summary dicts with interval deltas).
+
+**Rotation.** When an append would push the file past ``max_bytes``, the
+oldest half of the records is downsampled (every ``downsample``-th kept)
+and the file is rewritten atomically. Recent history stays dense, old
+history gets progressively coarser, and disk stays bounded — the JSONL
+analogue of an RRD.
+
+**Queries.** :meth:`MetricHistory.records` range-scans by time or step;
+:meth:`series` extracts one metric (``"train/mfu"`` or a histogram field
+like ``"serving/ttft_seconds:p95"``); :meth:`rate` computes a counter's
+per-second increase over a trailing window; :func:`merge_records` +
+:func:`windowed` aggregate across multiple host files (the fleet view
+and ``dstpu-report --compare`` build on these).
+
+**Subscribers.** :meth:`subscribe` hooks fire on every append — the SLO
+burn-rate engine (:mod:`~deepspeed_tpu.telemetry.slo`) rides the same
+flush, so objectives are evaluated exactly as often as history is
+written, with no extra registry lock pass.
+
+A ``path=None`` history is memory-only (bounded deque): the SLO engine
+still works in processes that don't want a file on disk.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Tuple,
+                    Union)
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+DEFAULT_DOWNSAMPLE = 2
+#: memory-only mode / in-memory tail capacity (records)
+DEFAULT_MEM_RECORDS = 512
+
+Record = Dict[str, Any]
+
+
+def resolve_metric(record: Record, name: str,
+                   prefer_interval: bool = False) -> Optional[float]:
+    """Read one metric out of a history record.
+
+    ``name`` is ``"area/metric"`` for counters/gauges, or
+    ``"area/metric:field"`` for a histogram summary field (``p50``,
+    ``p90``, ``p95``, ``p99``, ``mean``, ``count``, ``min``, ``max``;
+    default ``mean``). With ``prefer_interval`` the histogram's
+    ``interval`` sub-summary wins when it has samples — and a record
+    whose interval is EMPTY yields ``None`` (no traffic means no
+    judgment, not a stale all-time percentile). Returns ``None`` when
+    the record doesn't carry the metric.
+    """
+    base, _, field = name.partition(":")
+    v = record.get("m", {}).get(base)
+    if v is None:
+        return None
+    if not isinstance(v, dict):
+        return None if field else float(v)
+    field = field or "mean"
+    if prefer_interval and "interval" in v:
+        iv = v["interval"]
+        if not iv.get("count"):
+            return None
+        if field in iv:
+            return float(iv[field])
+    out = v.get(field)
+    return float(out) if out is not None else None
+
+
+def _parse_line(line: str) -> Optional[Record]:
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None                     # torn tail from a killed writer
+    return rec if isinstance(rec, dict) and "m" in rec else None
+
+
+def load_records(path: str) -> List[Record]:
+    """All records in one history file, oldest first; corrupt/torn lines
+    are skipped (an append racing a kill must not poison the reader)."""
+    out: List[Record] = []
+    with open(path) as fh:
+        for line in fh:
+            rec = _parse_line(line)
+            if rec is not None:
+                out.append(rec)
+    return out
+
+
+def merge_records(paths: Iterable[str]) -> List[Record]:
+    """Records from several per-host history files, merged time-ordered
+    (each record carries its ``host``, so the fleet stays attributable)."""
+    out: List[Record] = []
+    for p in paths:
+        out.extend(load_records(p))
+    out.sort(key=lambda r: (r.get("ts", 0.0), r.get("step", 0)))
+    return out
+
+
+def windowed(records: List[Record], name: str, window_s: float,
+             agg: str = "mean",
+             prefer_interval: bool = False) -> List[Tuple[float, float]]:
+    """Aggregate one metric over fixed time windows across (possibly
+    multi-host) records: ``[(window_start_ts, value), ...]``. ``agg`` is
+    ``mean`` | ``max`` | ``min`` | ``sum`` | ``last``."""
+    if window_s <= 0:
+        raise ValueError(f"window_s must be > 0, got {window_s}")
+    fns: Dict[str, Callable[[List[float]], float]] = {
+        "mean": lambda vs: sum(vs) / len(vs), "max": max, "min": min,
+        "sum": sum, "last": lambda vs: vs[-1]}
+    if agg not in fns:
+        raise ValueError(f"agg must be one of {sorted(fns)}, got {agg!r}")
+    buckets: Dict[float, List[float]] = {}
+    for rec in records:
+        v = resolve_metric(rec, name, prefer_interval=prefer_interval)
+        if v is None:
+            continue
+        key = float(rec.get("ts", 0.0)) // window_s * window_s
+        buckets.setdefault(key, []).append(v)
+    return [(k, fns[agg](vs)) for k, vs in sorted(buckets.items())]
+
+
+class MetricHistory:
+    """Append-only per-host metric history (JSONL ring) + query API."""
+
+    def __init__(self, path: Optional[str] = None,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 downsample: int = DEFAULT_DOWNSAMPLE,
+                 host: Optional[str] = None,
+                 mem_records: int = DEFAULT_MEM_RECORDS,
+                 clock=time.time):
+        self.path = os.path.abspath(path) if path else None
+        self.max_bytes = int(max_bytes)
+        self.downsample = max(2, int(downsample))
+        self.host = host or socket.gethostname()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._subs: List[Callable[[Record], None]] = []
+        self._tail: deque = deque(maxlen=max(1, mem_records))
+        self.appended = 0
+        self.rotations = 0
+        self._size = 0
+        if self.path:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            try:
+                self._size = os.path.getsize(self.path)
+            except OSError:
+                self._size = 0
+
+    # -- writing ------------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[Record], None]) -> None:
+        """Call ``fn(record)`` after every append (SLO engine hook)."""
+        self._subs.append(fn)
+
+    def append(self, step: int, metrics: Dict[str, Any]) -> Record:
+        """Append one flush record; rotates first when the file would
+        outgrow ``max_bytes``. Subscriber exceptions are logged, never
+        raised into the flush path."""
+        rec: Record = {"ts": float(self._clock()), "step": int(step),
+                       "host": self.host, "m": metrics}
+        line = json.dumps(rec, separators=(",", ":"), default=float) + "\n"
+        with self._lock:
+            self._tail.append(rec)
+            self.appended += 1
+            if self.path:
+                if self._size + len(line) > self.max_bytes:
+                    self._rotate_locked()
+                with open(self.path, "a") as fh:
+                    fh.write(line)
+                self._size += len(line)
+        for fn in list(self._subs):
+            try:
+                fn(rec)
+            except Exception as e:                   # noqa: BLE001
+                logger.warning(f"metric-history subscriber failed: {e}")
+        return rec
+
+    def _rotate_locked(self) -> None:
+        """Downsample the oldest half (keep every ``downsample``-th
+        record) and atomically rewrite. Repeated rotations coarsen old
+        history further while the recent half stays dense."""
+        try:
+            recs = load_records(self.path)
+        except OSError:
+            recs = []
+        split = len(recs) // 2
+        kept = recs[:split][::self.downsample] + recs[split:]
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            for r in kept:
+                fh.write(json.dumps(r, separators=(",", ":"),
+                                    default=float) + "\n")
+        os.replace(tmp, self.path)
+        self._size = os.path.getsize(self.path)
+        self.rotations += 1
+
+    # -- queries ------------------------------------------------------------
+
+    def records(self, start_ts: Optional[float] = None,
+                end_ts: Optional[float] = None,
+                start_step: Optional[int] = None,
+                end_step: Optional[int] = None) -> List[Record]:
+        """Range scan (inclusive bounds), oldest first — from the file
+        when backed by one, else the in-memory tail."""
+        if self.path and os.path.exists(self.path):
+            recs = load_records(self.path)
+        else:
+            with self._lock:
+                recs = list(self._tail)
+        out = []
+        for r in recs:
+            if start_ts is not None and r.get("ts", 0.0) < start_ts:
+                continue
+            if end_ts is not None and r.get("ts", 0.0) > end_ts:
+                continue
+            if start_step is not None and r.get("step", 0) < start_step:
+                continue
+            if end_step is not None and r.get("step", 0) > end_step:
+                continue
+            out.append(r)
+        return out
+
+    def series(self, name: str, prefer_interval: bool = False,
+               **range_kw) -> List[Tuple[float, int, float]]:
+        """``[(ts, step, value), ...]`` for one metric (see
+        :func:`resolve_metric` for the ``name`` grammar)."""
+        out = []
+        for r in self.records(**range_kw):
+            v = resolve_metric(r, name, prefer_interval=prefer_interval)
+            if v is not None:
+                out.append((float(r.get("ts", 0.0)),
+                            int(r.get("step", 0)), v))
+        return out
+
+    def rate(self, name: str, window_s: float = 60.0,
+             end_ts: Optional[float] = None) -> Optional[float]:
+        """Per-second increase of a counter-style metric over the
+        trailing ``window_s`` (``prometheus rate()`` semantics, minus
+        extrapolation). ``None`` with fewer than two in-window points;
+        a counter reset (decrease) restarts from the reset point."""
+        pts = self.series(name, end_ts=end_ts)
+        if end_ts is None and pts:
+            end_ts = pts[-1][0]
+        pts = [p for p in pts if p[0] >= (end_ts or 0.0) - window_s]
+        if len(pts) < 2:
+            return None
+        lo = pts[0]
+        for p in pts[1:]:
+            if p[2] < lo[2]:
+                lo = p                  # reset — measure from here
+        hi = pts[-1]
+        if hi[0] <= lo[0]:
+            return None
+        return (hi[2] - lo[2]) / (hi[0] - lo[0])
+
+    def last(self) -> Optional[Record]:
+        with self._lock:
+            if self._tail:
+                return self._tail[-1]
+        if self.path and os.path.exists(self.path):
+            recs = load_records(self.path)
+            return recs[-1] if recs else None
+        return None
+
+
+Union  # noqa: B018  (re-exported typing name used by annotations above)

@@ -1,0 +1,483 @@
+"""ISSUE 9: metric history (timeseries), SLO burn-rate engine, fleet
+view (dstpu-top), and the dstpu_report --compare regression gate.
+
+Acceptance flows covered here:
+- a serving-shaped latency breach drives slo/* burn gauges up, flips
+  /healthz to 503 NAMING the objective, flight-records the transition,
+  and recovers when latency drops — all through one registry flush path;
+- the history file stays size-bounded under rotation and recent records
+  survive dense while old history coarsens;
+- dstpu-top --once renders the degraded host offline from history files;
+- dstpu_report --compare exits 1 on a regression beyond the noise band.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import doctor, fleet
+from deepspeed_tpu.telemetry.endpoint import MetricsServer
+from deepspeed_tpu.telemetry.registry import (MetricsRegistry,
+                                              percentile_from_counts)
+from deepspeed_tpu.telemetry.slo import (Objective, SLOEngine,
+                                         evaluate_history)
+from deepspeed_tpu.telemetry.timeseries import (MetricHistory, load_records,
+                                                merge_records,
+                                                resolve_metric, windowed)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture()
+def clean_diagnostics():
+    telemetry.flight_recorder.clear()
+    yield
+    telemetry.flight_recorder.clear()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_percentile_log_linear_interpolation():
+    """p95/p99 land inside the bucket, not on its upper edge, and the
+    overflow bucket clamps to the tracked max."""
+    r = MetricsRegistry()
+    h = r.histogram("serving/ttft_seconds", lo=1e-3, hi=10.0)
+    for _ in range(90):
+        h.record(0.010)
+    for _ in range(10):
+        h.record(1.0)
+    p50 = h.percentile(50)
+    # 0.010 lands in a bucket whose raw upper edge is well above it; the
+    # interpolated value must stay near the observed point, not snap to
+    # the edge
+    edge = min(b for b in h.bounds if b >= 0.010)
+    assert p50 < edge
+    assert 0.001 <= p50 <= 0.05
+    # monotone and inside the observed range
+    ps = [h.percentile(p) for p in (10, 50, 90, 95, 99, 100)]
+    assert ps == sorted(ps)
+    assert ps[-1] <= 1.0 + 1e-9
+    # overflow: values beyond hi report the exact tracked max
+    h.record(123.0)
+    assert h.percentile(99.9) == 123.0
+
+
+def test_percentile_from_counts_empty_and_single():
+    assert percentile_from_counts([1, 2], [0, 0, 0], 0, 95) == 0.0
+    # single sample in one bucket: clamped into [vmin, vmax]
+    v = percentile_from_counts([1.0, 2.0, 4.0], [0, 1, 0, 0], 1, 50,
+                               vmin=1.5, vmax=1.5)
+    assert v == 1.5
+
+
+def test_snapshot_interval_deltas():
+    """snapshot(interval=True) summarizes only samples since the last
+    snapshot — the recovery signal the SLO engine judges on."""
+    r = MetricsRegistry()
+    h = r.histogram("serving/ttft_seconds", lo=1e-3, hi=10.0)
+    for _ in range(10):
+        h.record(1.0)
+    s1 = r.snapshot(interval=True)
+    assert s1["serving/ttft_seconds"]["interval"]["count"] == 10
+    assert s1["serving/ttft_seconds"]["interval"]["p95"] > 0.5
+    for _ in range(10):
+        h.record(0.01)
+    s2 = r.snapshot(interval=True)
+    iv = s2["serving/ttft_seconds"]["interval"]
+    assert iv["count"] == 10
+    # interval p95 reflects the NEW fast samples; cumulative p95 is
+    # still dominated by the old slow ones
+    assert iv["p95"] < 0.5
+    assert s2["serving/ttft_seconds"]["p95"] > 0.5
+    # no new samples → empty interval
+    s3 = r.snapshot(interval=True)
+    assert s3["serving/ttft_seconds"]["interval"]["count"] == 0
+
+
+def test_flush_to_monitor_history_sink(tmp_path):
+    """The history sink rides the same flush whether or not a monitor is
+    attached; a disabled monitor alone still short-circuits."""
+    r = MetricsRegistry()
+    r.counter("train/steps").inc(7)
+    hist = MetricHistory(path=str(tmp_path / "h.jsonl"), host="h0")
+    r.flush_to_monitor(None, step=7, history=hist)
+    recs = hist.records()
+    assert len(recs) == 1
+    assert recs[0]["step"] == 7
+    assert recs[0]["m"]["train/steps"] == 7.0
+    # no monitor AND no history → no-op, nothing appended
+    r.flush_to_monitor(None, step=8)
+    assert len(hist.records()) == 1
+
+
+# -------------------------------------------------------------- timeseries
+
+
+def test_history_rotation_downsampling_roundtrip(tmp_path):
+    """The file never outgrows max_bytes (mod one record); after
+    rotation old history is coarser and recent history stays dense."""
+    clock = FakeClock()
+    path = str(tmp_path / "hist.jsonl")
+    hist = MetricHistory(path=path, max_bytes=4096, downsample=2,
+                         host="h0", clock=clock)
+    for i in range(400):
+        clock.advance(1.0)
+        hist.append(i, {"train/steps": float(i)})
+    assert hist.rotations >= 1
+    assert os.path.getsize(path) <= 4096 + 128
+    recs = load_records(path)
+    steps = [r["step"] for r in recs]
+    assert steps == sorted(steps)
+    assert steps[-1] == 399                      # newest record survived
+    # the most recent half is dense (consecutive steps)
+    tail = steps[-10:]
+    assert tail == list(range(tail[0], tail[0] + 10))
+    # old history kept but thinned
+    assert steps[0] < steps[-1] - len(steps)
+
+
+def test_history_query_api_multi_host(tmp_path):
+    clock = FakeClock()
+    paths = []
+    for host in ("h0", "h1"):
+        p = str(tmp_path / f"{host}.jsonl")
+        paths.append(p)
+        clock.t = 1000.0
+        hist = MetricHistory(path=p, host=host, clock=clock)
+        for i in range(5):
+            clock.advance(10.0)
+            hist.append(i, {"serving/tokens_out": float(i * 100),
+                            "train/mfu": 0.4 if host == "h0" else 0.2})
+    merged = merge_records(paths)
+    assert len(merged) == 10
+    assert {r["host"] for r in merged} == {"h0", "h1"}
+    # per-host rate: 100 tokens / 10 s
+    h0 = MetricHistory(path=paths[0])
+    assert h0.rate("serving/tokens_out", window_s=100.0) == \
+        pytest.approx(10.0)
+    # windowed mean across hosts
+    pts = windowed(merged, "train/mfu", window_s=1e6, agg="mean")
+    assert len(pts) == 1
+    assert pts[0][1] == pytest.approx(0.3)
+    # range scan + series
+    assert len(h0.records(start_step=2)) == 3
+    series = h0.series("serving/tokens_out")
+    assert [v for _, _, v in series] == [0.0, 100.0, 200.0, 300.0, 400.0]
+
+
+def test_history_skips_corrupt_lines(tmp_path):
+    p = tmp_path / "h.jsonl"
+    good = json.dumps({"ts": 1.0, "step": 1, "host": "h",
+                       "m": {"train/steps": 1.0}})
+    p.write_text(good + "\n{torn json\n" + good + "\n")
+    assert len(load_records(str(p))) == 2
+
+
+def test_resolve_metric_field_grammar():
+    rec = {"m": {"train/mfu": 0.4,
+                 "serving/ttft_seconds": {
+                     "count": 10, "mean": 0.5, "p95": 0.9,
+                     "interval": {"count": 0}}}}
+    assert resolve_metric(rec, "train/mfu") == 0.4
+    assert resolve_metric(rec, "serving/ttft_seconds:p95") == 0.9
+    assert resolve_metric(rec, "serving/ttft_seconds") == 0.5
+    # empty interval + prefer_interval → None (no traffic, no judgment)
+    assert resolve_metric(rec, "serving/ttft_seconds:p95",
+                          prefer_interval=True) is None
+    assert resolve_metric(rec, "missing/metric") is None
+
+
+# --------------------------------------------------------------------- slo
+
+
+def test_objective_parse_grammar():
+    o = Objective.parse("serving/ttft_seconds:p95 <= 0.5")
+    assert (o.metric, o.op, o.target) == ("serving/ttft_seconds:p95",
+                                          "<=", 0.5)
+    assert o.name == "serving_ttft_seconds_p95"
+    d = Objective.parse({"metric": "train/mfu", "op": ">=",
+                         "target": 0.3, "name": "mfu_floor",
+                         "budget": 0.2})
+    assert d.name == "mfu_floor" and d.budget == 0.2
+    with pytest.raises(ValueError):
+        Objective.parse("train/mfu ~= 0.3")
+    with pytest.raises(ValueError):
+        SLOEngine(["train/mfu >= 0.1"], fast_window_s=600,
+                  slow_window_s=60)
+
+
+def test_burn_rate_math_breach_and_recovery(clean_diagnostics):
+    """Exact multi-window arithmetic on a fake clock: all-bad at budget
+    0.1 burns at 10x; breach needs BOTH windows over threshold; the
+    fast window alone drives recovery."""
+    clock = FakeClock()
+    eng = SLOEngine(["train/step_time_ms <= 100"], budget=0.1,
+                    fast_window_s=10.0, slow_window_s=60.0,
+                    burn_threshold=2.0, publish=False, clock=clock)
+    obj = eng.objectives[0]
+
+    def rec(v):
+        return {"ts": clock.advance(2.0), "step": 0,
+                "m": {"train/step_time_ms": v}}
+
+    # healthy traffic fills both windows
+    for _ in range(10):
+        eng.observe(rec(50.0))
+    assert obj.burn_fast == 0.0 and not obj.breached
+    # sustained badness: fast window goes all-bad (burn 10) quickly,
+    # but the slow window must ALSO cross 2x before the breach flips
+    flipped_at = None
+    for i in range(12):
+        eng.observe(rec(500.0))
+        if obj.breached and flipped_at is None:
+            flipped_at = i
+            assert obj.burn_fast >= 2.0
+            assert obj.burn_slow >= 2.0
+    assert flipped_at is not None and flipped_at >= 2
+    # sustained badness: the fast window is now all-bad → exact 10x
+    assert obj.burn_fast == pytest.approx(10.0)
+    # recovery: good traffic drains the fast window below threshold even
+    # while the slow window still remembers the incident
+    for _ in range(6):
+        eng.observe(rec(50.0))
+    assert not obj.breached
+    assert obj.burn_slow > 0.0
+    assert eng.summary()["breached"] == []
+    assert eng.summary()["evaluated"] == 28
+
+
+def test_breach_publishes_gauges_and_flight_records(clean_diagnostics):
+    clock = FakeClock()
+    reg = telemetry.registry
+    eng = SLOEngine(["serving/ttft_seconds:p95 <= 0.1"], budget=0.5,
+                    fast_window_s=10.0, slow_window_s=20.0,
+                    burn_threshold=1.5, clock=clock)
+    for _ in range(8):
+        eng.observe({"ts": clock.advance(2.0), "step": 0,
+                     "m": {"serving/ttft_seconds": {
+                         "count": 5, "mean": 0.9, "p95": 0.9,
+                         "interval": {"count": 5, "p95": 0.9}}}})
+    assert eng.objectives[0].breached
+    assert reg.gauge("slo/serving_ttft_seconds_p95/breached").value == 1.0
+    assert reg.gauge("slo/serving_ttft_seconds_p95/burn_fast").value == \
+        pytest.approx(2.0)
+    assert reg.gauge("slo/breached").value == 1.0
+    assert reg.gauge("slo/worst_burn").value >= 1.5
+    events = [e for e in telemetry.flight_recorder.snapshot()["events"]
+              if e.get("kind") == "slo_breach"]
+    assert events and events[0]["objective"] == "serving_ttft_seconds_p95"
+
+
+def test_healthz_names_breaching_objective(clean_diagnostics):
+    """/healthz flips to 503 naming the objective on breach, back to 200
+    on recovery — and an independent serving-source degradation is not
+    clobbered by the SLO source clearing."""
+    clock = FakeClock()
+    srv = MetricsServer(port=0, host="127.0.0.1")
+    try:
+        eng = SLOEngine(["serving/ttft_seconds:p95 <= 0.1"], budget=0.5,
+                        fast_window_s=10.0, slow_window_s=20.0,
+                        burn_threshold=1.5, healthz=srv, clock=clock)
+
+        def hit(p95):
+            eng.observe({"ts": clock.advance(2.0), "step": 0,
+                         "m": {"serving/ttft_seconds": {
+                             "count": 5, "mean": p95, "p95": p95,
+                             "interval": {"count": 5, "p95": p95}}}})
+
+        def healthz():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/healthz",
+                        timeout=5) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        for _ in range(8):
+            hit(0.9)
+        code, doc = healthz()
+        assert code == 503
+        assert doc["status"] == "degraded"
+        assert "serving_ttft_seconds_p95" in doc["reason"]
+        assert "<= 0.1" in doc["reason"]
+        # another source holds its own degradation across SLO recovery
+        srv.set_degraded(True, reason="draining", source="serving")
+        for _ in range(8):
+            hit(0.01)
+        assert not eng.objectives[0].breached
+        code, doc = healthz()
+        assert code == 503 and doc["reason"] == "draining"
+        srv.set_degraded(False, source="serving")
+        assert healthz()[0] == 200
+    finally:
+        srv.close()
+
+
+def test_evaluate_history_offline(tmp_path, clean_diagnostics):
+    clock = FakeClock()
+    hist = MetricHistory(path=str(tmp_path / "h.jsonl"), clock=clock)
+    for i in range(20):
+        clock.advance(2.0)
+        hist.append(i, {"train/step_time_ms": {
+            "count": 5, "mean": 500.0, "p95": 500.0,
+            "interval": {"count": 5, "p95": 500.0}}})
+    out = evaluate_history(load_records(str(tmp_path / "h.jsonl")),
+                           {"objectives": ["train/step_time_ms:p95 <= 100"],
+                            "budget": 0.1, "fast_window_s": 10.0,
+                            "slow_window_s": 30.0})
+    assert out["objectives"] == 1 and out["evaluated"] == 20
+    assert out["worst_burn"] == pytest.approx(10.0)
+    assert out["breached"] == ["train_step_time_ms_p95"]
+    # offline replay is side-effect-free
+    assert not [e for e in telemetry.flight_recorder.snapshot()["events"]
+                if e.get("kind") == "slo_breach"]
+
+
+# ------------------------------------------------------------------ doctor
+
+
+def test_doctor_slo_breach_verdict(clean_diagnostics):
+    dump = {"meta": {"hostname": "tpu-vm-3"}, "reason": "demand",
+            "steps": [{"step": 1, "dur_ms": 10.0}],
+            "events": [{"kind": "slo_breach", "ts": 5.0, "step": 1,
+                        "objective": "ttft_p95",
+                        "metric": "serving/ttft_seconds:p95",
+                        "op": "<=", "target": 0.5, "value": 0.9,
+                        "burn_fast": 4.0, "burn_slow": 2.5}]}
+    report = doctor.analyze([dump])
+    assert "SLO BREACH" in report["verdict"]
+    assert "ttft_p95" in report["verdict"]
+    assert "tpu-vm-3" in report["verdict"]
+    text = doctor.render(report)
+    assert "SLO transitions (1 still open)" in text
+    # a later recovery closes it and drops the verdict a tier
+    dump["events"].append({"kind": "slo_recovered", "ts": 9.0, "step": 2,
+                           "objective": "ttft_p95", "value": 0.1})
+    report2 = doctor.analyze([dump])
+    assert "RECOVERED" in report2["verdict"]
+    assert not report2["slo"]["open"]
+
+
+# ------------------------------------------------------------------- fleet
+
+
+def test_parse_prometheus_text_roundtrip():
+    r = MetricsRegistry()
+    r.counter("train/steps").inc(42)
+    r.gauge("train/mfu").set(0.41)
+    h = r.histogram("serving/ttft_seconds", lo=1e-3, hi=10.0)
+    for v in (0.01, 0.02, 0.5):
+        h.record(v)
+    parsed = fleet.parse_prometheus_text(r.prometheus_text())
+    assert parsed["train_steps"] == 42.0
+    assert parsed["train_mfu"] == 0.41
+    hist = parsed["serving_ttft_seconds"]
+    assert hist["count"] == 3.0
+    # exposition buckets carry no exact max, so p95 may land anywhere
+    # inside the bucket holding 0.5 — bound it by that bucket's edges
+    p = fleet.hist_percentile(hist, 95)
+    lower = max(le for le, _ in hist["buckets"] if le < 0.5)
+    upper = min(le for le, _ in hist["buckets"] if le >= 0.5)
+    assert lower < p <= upper + 1e-9
+
+
+def test_dstpu_top_once_offline_golden(tmp_path, capsys):
+    """--once --history renders the degraded host and exits 2."""
+    clock = FakeClock()
+    p = str(tmp_path / "tpu-vm-0.jsonl")
+    hist = MetricHistory(path=p, host="tpu-vm-0", clock=clock)
+    for i in range(3):
+        clock.advance(2.0)
+        hist.append(i * 10, {
+            "train/steps": float(i * 10), "train/mfu": 0.41,
+            "serving/ttft_seconds": {
+                "count": 10, "mean": 0.02, "p95": 0.03,
+                "interval": {"count": 5, "p95": 0.025}},
+            "slo/worst_burn": 4.2, "slo/breached": 1.0})
+    rc = fleet.main(["--once", "--history", p])
+    out = capsys.readouterr().out
+    assert rc == 2                                # degraded host present
+    assert "tpu-vm-0" in out
+    assert "degraded" in out
+    assert "0.410" in out                         # MFU column
+    assert "5.00" in out                          # step rate: 10 / 2 s
+    assert "25.0" in out                          # interval ttft p95 ms
+    assert "4.20" in out                          # burn column
+    # aggregate gauges republished for the supervisor's own /metrics
+    assert telemetry.registry.gauge("fleet/hosts").value == 1.0
+    assert telemetry.registry.gauge("fleet/hosts_degraded").value == 1.0
+    assert telemetry.registry.gauge("fleet/worst_burn").value == \
+        pytest.approx(4.2)
+
+
+def test_dstpu_top_live_poll(tmp_path):
+    """Live mode scrapes a real MetricsServer and reports its health."""
+    telemetry.registry.counter("train/steps").inc()
+    srv = MetricsServer(port=0, host="127.0.0.1")
+    try:
+        srv.set_degraded(True, reason="slo:ttft burning", source="slo")
+        sample = fleet.poll_host(fleet.HostSample(f"127.0.0.1:{srv.port}"))
+        assert sample.ok
+        row = sample.row(now=sample.ts)
+        assert row["status"] == "degraded"
+        assert "slo:ttft" in row["reason"]
+        assert row["step"] >= 1.0
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------------ compare
+
+
+def test_report_compare_regression_flag(tmp_path, capsys):
+    from deepspeed_tpu.env_report import main as report_main
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text(json.dumps({"metric": "tokens/sec/chip", "value": 1000,
+                             "unit": "tokens/s/chip"}) + "\n" +
+                 json.dumps({"metric": "serving ttft p95", "value": 0.02,
+                             "unit": "s"}) + "\n")
+    # throughput down 20%, latency up 50% → both regress
+    b.write_text(json.dumps({"metric": "tokens/sec/chip", "value": 800,
+                             "unit": "tokens/s/chip"}) + "\n" +
+                 json.dumps({"metric": "serving ttft p95", "value": 0.03,
+                             "unit": "s"}) + "\n")
+    assert report_main(["--compare", str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert out.count("REGRESSION") == 2
+    # identical runs pass; a wide noise band forgives the drop
+    assert report_main(["--compare", str(a), str(a)]) == 0
+    assert report_main(["--compare", str(a), str(b),
+                        "--noise", "0.6"]) == 0
+
+
+def test_report_compare_history_mode(tmp_path):
+    from deepspeed_tpu.env_report import main as report_main
+    clock = FakeClock()
+    paths = {}
+    for name, mfu in (("a", 0.45), ("b", 0.30)):
+        p = str(tmp_path / f"{name}.jsonl")
+        paths[name] = p
+        clock.t = 1000.0
+        hist = MetricHistory(path=p, host="h", clock=clock)
+        for i in range(10):
+            clock.advance(2.0)
+            hist.append(i, {"train/mfu": mfu,
+                            "train/steps": float(i * 4)})
+    assert report_main(["--compare", paths["a"], paths["b"]]) == 1
+    assert report_main(["--compare", paths["a"], paths["a"]]) == 0
