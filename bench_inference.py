@@ -158,6 +158,142 @@ def bench_shared_prefix(args) -> None:
     print(json.dumps(result))
 
 
+def bench_router(args) -> None:
+    """multi-replica scenario: the SAME shared-prefix request stream
+    served through the fault-tolerant router over ``--replicas N``
+    in-process replicas, optionally under a ``--chaos`` fault plan
+    (e.g. ``serving_step:8:replica_kill:router``). Stamps per-replica
+    tok/s, failover count and recovery time into the BENCH JSON; when
+    the plan degrades a replica (``replica_slow``), runs a hedging A/B
+    (same stream, hedge off vs on) and stamps the p99 TTFT improvement
+    hedged dispatch buys back. Prints ONE JSON line."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.resilience.faults import fault_injector
+    from deepspeed_tpu.serving import LocalReplica, Router, ServingFrontend
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size = args.size or ("1b" if on_tpu else "tiny")
+    ds.build_mesh(data=1, devices=jax.devices()[:1])
+    seq_cap = 256
+    model = llama3_config(size, max_seq_len=seq_cap, tie_embeddings=True)
+    dtype = "bfloat16" if on_tpu else "float32"
+    params = init_params(model, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    n_req = args.n_requests
+    conc = min(args.n_prompts, 16)
+    new = max(2, min(args.new_tokens, 16))
+    plen, share = 48, 24                      # 50%-shared → affinity work
+    prefix = rng.integers(0, model.vocab_size, size=share)
+    prompts = [np.concatenate(
+        [prefix, rng.integers(0, model.vocab_size, size=plen - share)])
+        for _ in range(n_req)]
+    block = 16
+    blocks_per_seq = -(-(plen + new) // block)
+    eng_cfg = {"dtype": dtype,
+               "num_blocks": conc * blocks_per_seq + blocks_per_seq + 16,
+               "block_size": block, "max_seq_len": seq_cap,
+               "prefill_chunk": 32, "max_batch_tokens": 1024,
+               "max_sequences": conc,
+               "use_pallas": (False if args.no_pallas else None)}
+
+    c = telemetry.registry.counter
+
+    def run_pool(hedge: bool) -> dict:
+        """One fresh pool + router over the stream; per-mode counter
+        deltas so A/B modes don't bleed into each other."""
+        replicas = [
+            LocalReplica(f"r{i}", ServingFrontend(
+                RaggedInferenceEngineTPU(model, dict(eng_cfg),
+                                         params=params),
+                max_queue=n_req, enable_prefix_cache=False))
+            for i in range(args.replicas)]
+        router = Router(replicas, hedge=hedge,
+                        hedge_delay_s=args.hedge_delay)
+        # warm every replica's compile buckets before arming chaos so
+        # the drill times recovery, not XLA
+        warm = [router.submit([int(t) for t in p], max_new_tokens=new)
+                for p in prompts[:args.replicas * 2]]
+        router.run_until_idle(wall_timeout_s=600.0)
+        assert all(w.finish_reason == "length" for w in warm)
+        base = {k: c(k).value for k in (
+            "router/failovers", "router/hedges", "router/hedges_won",
+            "resilience/faults_injected", "resilience/recoveries")}
+        if args.chaos:
+            fault_injector.arm(args.chaos, _env=False)
+        tok0 = dict(router.replica_tokens)
+        t0 = time.perf_counter()
+        reqs = [router.submit([int(t) for t in p], max_new_tokens=new)
+                for p in prompts]
+        router.run_until_idle(wall_timeout_s=600.0)
+        wall = time.perf_counter() - t0
+        fault_injector.disarm()
+        toks = sum(len(r.tokens_out) for r in reqs)
+        stats = router.stats()
+        out = {
+            "tok_s": round(toks / wall, 2), "wall_s": round(wall, 3),
+            "completed": sum(r.finish_reason == "length" for r in reqs),
+            "requests": n_req,
+            "replica_tok_s": {
+                name: round((stats["replica_tokens"].get(name, 0) -
+                             tok0.get(name, 0)) / wall, 2)
+                for name in tok0},
+            "replica_states": stats["replicas"],
+            "failovers": int(c("router/failovers").value -
+                             base["router/failovers"]),
+            "hedges": int(c("router/hedges").value -
+                          base["router/hedges"]),
+            "hedges_won": int(c("router/hedges_won").value -
+                              base["router/hedges_won"]),
+            "recovery_s": stats["last_recovery_s"],
+            "ttft_p99_s": round(router.ttft.percentile(99), 4),
+            "ledger": {
+                "faults": int(c("resilience/faults_injected").value -
+                              base["resilience/faults_injected"]),
+                "recoveries": int(c("resilience/recoveries").value -
+                                  base["resilience/recoveries"])},
+        }
+        router.close()
+        return out
+
+    hedge_ab = None
+    if args.chaos and "replica_slow" in args.chaos:
+        off = run_pool(hedge=False)
+        on = run_pool(hedge=True)
+        hedge_ab = {
+            "hedge_off": off, "hedge_on": on,
+            "p99_ttft_improvement": round(
+                off["ttft_p99_s"] / max(1e-9, on["ttft_p99_s"]), 3)}
+        headline = on
+    else:
+        headline = run_pool(hedge=not args.no_hedge)
+
+    result = {
+        "metric": f"multi-replica router llama3-{size}, {n_req} req "
+                  f"stream @ {args.replicas} replicas"
+                  + (f", chaos [{args.chaos}]" if args.chaos else ""),
+        "value": headline["tok_s"],
+        "unit": "gen tokens/s (router)",
+        "vs_baseline": (hedge_ab["p99_ttft_improvement"]
+                        if hedge_ab else 1.0),
+        "extra": {
+            "replicas": args.replicas,
+            "chaos": args.chaos,
+            **headline,
+            "slo": _slo_extra(),
+        },
+    }
+    if hedge_ab is not None:
+        result["extra"]["hedge_ab"] = hedge_ab
+    print(json.dumps(result))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default=None)
@@ -173,11 +309,25 @@ def main() -> None:
                     help="weight-only quantized serving (bare flag = "
                          "int8; int4 quarters the decode weight fetch)")
     ap.add_argument("--scenario", default="stream",
-                    choices=("stream", "shared_prefix_stream"),
+                    choices=("stream", "shared_prefix_stream", "router"),
                     help="stream: ragged vs padded request stream; "
                          "shared_prefix_stream: serving frontend with "
                          "the radix prefix cache on vs off over "
-                         "50%%-shared prompts")
+                         "50%%-shared prompts; router: the stream over "
+                         "--replicas N fault-tolerant replicas, "
+                         "optionally under a --chaos plan")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="router scenario: replica pool size")
+    ap.add_argument("--chaos", default=None, metavar="PLAN",
+                    help="router scenario: fault plan armed for the "
+                         "measured stream (e.g. 'serving_step:8:"
+                         "replica_kill:router'); a replica_slow plan "
+                         "triggers the hedging A/B")
+    ap.add_argument("--hedge-delay", type=float, default=0.05,
+                    help="router scenario: fixed hedge delay seconds "
+                         "(default 0.05 for deterministic A/Bs)")
+    ap.add_argument("--no-hedge", action="store_true",
+                    help="router scenario: disable hedged dispatch")
     ap.add_argument("--megastep", nargs="?", const=32, type=int,
                     default=None, metavar="K",
                     help="A/B the serving frontend stepwise vs decode "
@@ -189,6 +339,8 @@ def main() -> None:
 
     if args.scenario == "shared_prefix_stream":
         return bench_shared_prefix(args)
+    if args.scenario == "router":
+        return bench_router(args)
 
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"

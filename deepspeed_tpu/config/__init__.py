@@ -14,6 +14,7 @@ from deepspeed_tpu.config.config import (
     OffloadParamConfig,
     OptimizerConfig,
     PipelineParallelConfig,
+    RouterConfig,
     SchedulerConfig,
     SequenceParallelConfig,
     TensorParallelConfig,
@@ -28,5 +29,5 @@ __all__ = [
     "OffloadParamConfig", "TensorParallelConfig", "PipelineParallelConfig",
     "SequenceParallelConfig", "MoEConfig", "CommsLoggerConfig",
     "FlopsProfilerConfig", "MonitorConfig", "CheckpointConfig",
-    "ElasticityConfig", "ActivationCheckpointingConfig",
+    "ElasticityConfig", "ActivationCheckpointingConfig", "RouterConfig",
 ]

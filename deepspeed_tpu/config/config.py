@@ -491,6 +491,44 @@ class ServingConfig(TPUConfigModel):
     megastep_adaptive: bool = True
 
 
+class RouterConfig(TPUConfigModel):
+    """``"router"`` block → serving/router.py (the multi-replica tier;
+    docs/serving.md "Router, failover & draining"). Every knob has a
+    same-named ``Router(...)`` kwarg override."""
+    #: replicas a local pool spins up (dstpu-router / launcher --pool)
+    replicas: int = Field(default=2, ge=1)
+    #: leading prompt tokens hashed for prefix-affinity placement —
+    #: shared-prefix traffic lands where the radix cache is warm
+    affinity_tokens: int = Field(default=64, ge=1)
+    #: override affinity when the target is this many times busier than
+    #: the least-loaded replica (warm cache never justifies a hot queue)
+    spill_factor: float = Field(default=2.0, ge=1.0)
+    #: race a second replica for requests with no first token past the
+    #: hedge delay
+    hedge: bool = True
+    #: fixed hedge delay; None derives it from the router's observed
+    #: TTFT p95 (0.25s until 20 samples exist)
+    hedge_delay_s: Optional[float] = Field(default=None, gt=0)
+    #: mid-stream re-dispatches one request survives before it is
+    #: finished with reason ``"error"`` (fleet tier above
+    #: resilience.serving_retry_budget, which is per-replica)
+    retry_budget: int = Field(default=2, ge=0)
+    #: consecutive failure observations that open a replica's breaker
+    breaker_failures: int = Field(default=3, ge=1)
+    #: half-open probe backoff: initial, doubling per failed probe
+    breaker_backoff_s: float = Field(default=1.0, gt=0)
+    #: backoff cap
+    breaker_backoff_max_s: float = Field(default=30.0, gt=0)
+    #: an assigned stream making no progress for this long counts as a
+    #: breaker failure and fails over
+    stall_timeout_s: float = Field(default=30.0, gt=0)
+    #: poll replica /healthz+/metrics endpoints every N router polls
+    #: (0 disables the out-of-band sweep)
+    health_every: int = Field(default=50, ge=0)
+    #: per-pump latency a ``replica_slow`` chaos fault injects
+    chaos_slow_s: float = Field(default=0.25, ge=0)
+
+
 class ResilienceConfig(TPUConfigModel):
     """``"resilience"`` block → deepspeed_tpu/resilience (fault injection
     + recovery policy; docs/resilience.md). The fault plan makes chaos
@@ -638,6 +676,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     slo: SLOConfig = Field(default_factory=SLOConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    router: RouterConfig = Field(default_factory=RouterConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)

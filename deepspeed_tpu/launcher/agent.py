@@ -158,10 +158,138 @@ class LaunchAgent:
             signal.signal(signal.SIGINT, prev_int)
 
 
+class ReplicaPoolAgent:
+    """Spawn and supervise a local pool of N serving-replica processes —
+    the multi-process backend for the serving router
+    (serving/router.py; docs/serving.md "Router, failover & draining").
+
+    Each child runs ``cmd`` with ``DSTPU_REPLICA_NAME=r<i>`` and, when
+    ``base_port > 0``, ``DSTPU_HTTP_PORT=base_port+i`` (the replica's
+    /metrics + /healthz endpoint the router's breaker polls). Unlike
+    :class:`LaunchAgent` this supervisor is poll-driven and installs no
+    signal handlers, so it can run off the main thread or embedded in a
+    router process; restarts share one rolling per-replica budget so a
+    crash-looping replica gives up instead of flapping its breaker
+    forever. ``kill(name)`` has chaos semantics: SIGKILL the process
+    group and (optionally) leave it down — the router's failover is
+    what keeps the streams alive.
+    """
+
+    def __init__(self, cmd: List[str], n: int, base_port: int = 0,
+                 env: Optional[Dict[str, str]] = None,
+                 max_restarts: int = 2, restart_window_s: float = 300.0):
+        if n < 1:
+            raise ValueError("pool needs at least one replica")
+        self.cmd = cmd
+        self.names = [f"r{i}" for i in range(n)]
+        self.base_port = base_port
+        self.env = {**os.environ, **(env or {})}
+        self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
+        self._children: Dict[str, Optional[subprocess.Popen]] = {
+            name: None for name in self.names}
+        self._restart_times: Dict[str, List[float]] = {
+            name: [] for name in self.names}
+        #: replicas deliberately downed (kill/stop): never restarted
+        self._downed: set = set()
+        self.restarts = 0
+
+    def _spawn(self, name: str) -> subprocess.Popen:
+        i = self.names.index(name)
+        env = dict(self.env)
+        env["DSTPU_REPLICA_NAME"] = name
+        if self.base_port > 0:
+            env["DSTPU_HTTP_PORT"] = str(self.base_port + i)
+        child = subprocess.Popen(self.cmd, env=env, start_new_session=True)
+        self._children[name] = child
+        log_dist(f"replica pool: started {name} pid={child.pid}" +
+                 (f" port={self.base_port + i}" if self.base_port else ""))
+        return child
+
+    def start(self) -> "ReplicaPoolAgent":
+        for name in self.names:
+            self._spawn(name)
+        return self
+
+    def targets(self) -> List[str]:
+        """Scrape targets for a Router / dstpu-top over this pool."""
+        if self.base_port <= 0:
+            return []
+        return [f"127.0.0.1:{self.base_port + i}"
+                for i in range(len(self.names))]
+
+    def poll(self) -> Dict[str, str]:
+        """One supervision sweep: restart dead replicas inside their
+        rolling budget; returns per-replica phase (``running`` |
+        ``restarting`` | ``down`` | ``crash_loop``)."""
+        phases: Dict[str, str] = {}
+        now = time.monotonic()
+        for name, child in self._children.items():
+            if name in self._downed:
+                phases[name] = "down"
+                continue
+            if child is not None and child.poll() is None:
+                phases[name] = "running"
+                continue
+            times = self._restart_times[name] = [
+                t for t in self._restart_times[name]
+                if now - t <= self.restart_window_s]
+            if len(times) >= self.max_restarts:
+                phases[name] = "crash_loop"
+                continue
+            rc = child.returncode if child is not None else None
+            logger.warning(f"replica pool: {name} exited rc={rc}; "
+                           f"restart {len(times) + 1}/{self.max_restarts}")
+            times.append(now)
+            self.restarts += 1
+            self._spawn(name)
+            phases[name] = "restarting"
+        return phases
+
+    def kill(self, name: str, restart: bool = False) -> None:
+        """SIGKILL one replica's process group (chaos ``replica_kill``
+        at process scope). ``restart=True`` lets the next :meth:`poll`
+        bring it back (counts against the rolling budget)."""
+        child = self._children.get(name)
+        if child is None:
+            raise KeyError(f"no replica named {name!r}")
+        if not restart:
+            self._downed.add(name)
+        if child.poll() is None:
+            try:
+                os.killpg(os.getpgid(child.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            child.wait()
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        """SIGTERM the pool (drain window), then SIGKILL stragglers."""
+        self._downed.update(self.names)
+        live = [c for c in self._children.values()
+                if c is not None and c.poll() is None]
+        for c in live:
+            try:
+                os.killpg(os.getpgid(c.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        deadline = time.monotonic() + grace_s
+        for c in live:
+            try:
+                c.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(c.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                c.wait()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI: ``python -m deepspeed_tpu.launcher.agent -- cmd args...``
     with rendezvous env passed through (spawned over ssh by
-    launcher/runner.py on each host)."""
+    launcher/runner.py on each host). ``--pool N`` supervises N serving
+    replicas of the command instead (each with DSTPU_REPLICA_NAME and,
+    with ``--base-port``, its own DSTPU_HTTP_PORT)."""
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-restarts", type=int,
@@ -170,6 +298,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-host heartbeat JSON for dstpu-doctor "
                          "straggler naming (default: env "
                          "DSTPU_HEARTBEAT_FILE)")
+    ap.add_argument("--pool", type=int, default=0, metavar="N",
+                    help="supervise N serving-replica copies of the "
+                         "command instead of one worker")
+    ap.add_argument("--base-port", type=int, default=0,
+                    help="with --pool: replica i serves /metrics on "
+                         "base_port+i (DSTPU_HTTP_PORT)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = args.cmd
@@ -177,8 +311,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         print("usage: agent.py [--max-restarts N] [--heartbeat-file F] "
-              "-- prog args...", file=sys.stderr)
+              "[--pool N [--base-port P]] -- prog args...",
+              file=sys.stderr)
         return 2
+    if args.pool:
+        pool = ReplicaPoolAgent(
+            cmd, args.pool, base_port=args.base_port,
+            max_restarts=args.max_restarts or 2).start()
+        try:
+            while True:
+                phases = pool.poll()
+                if all(p in ("down", "crash_loop")
+                       for p in phases.values()):
+                    logger.error(f"replica pool: no replica left "
+                                 f"restartable ({phases}); exiting")
+                    return 1
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            pool.stop()
     return LaunchAgent(cmd, max_restarts=args.max_restarts,
                        heartbeat_file=args.heartbeat_file).run()
 

@@ -16,6 +16,8 @@ A **fault plan** is a ``;``-separated list of entries::
     step:20:nonfinite_grad      # poison step 20's gradients (update skipped)
     serving_step:5:engine_error # raise from engine.step_with_budget
     time:30:hang                # sleep forever once 30s of wall clock pass
+    serving_step:4:replica_kill:router   # router kills one replica
+    serving_step:4:replica_slow:router   # router degrades one replica
 
 Triggers: ``step`` (engine ``global_steps`` at train_batch entry),
 ``serving_step`` (frontend pump iterations), ``time`` (seconds since the
@@ -43,13 +45,20 @@ from deepspeed_tpu.utils.logging import logger
 #: which owns the site-specific mechanics (poisoning grads, tearing a
 #: fragment file)
 ACTION_KINDS = ("preempt", "io_error", "engine_error", "hang")
-ADVISORY_KINDS = ("nonfinite_grad", "torn_fragment")
+#: fleet-drill kinds the serving ROUTER acts on: kill a replica outright
+#: (dead process semantics — its streams fail over) or degrade it (slow
+#: pump — hedged dispatch races a healthy replica). Advisory, and pinned
+#: to the ``router`` site so a replica's own serving pump can never
+#: consume a fleet-scoped fault meant for the tier above it.
+REPLICA_KINDS = ("replica_kill", "replica_slow")
+ADVISORY_KINDS = ("nonfinite_grad", "torn_fragment") + REPLICA_KINDS
 KINDS = ACTION_KINDS + ADVISORY_KINDS
 TRIGGERS = ("step", "serving_step", "time")
 
 #: hook sites a scoped entry (``step:12:io_error:checkpoint``) may name;
-#: unscoped entries fire at any site their trigger matches
-SITES = ("train_step", "checkpoint", "serving_step", "launcher")
+#: unscoped entries fire at any site their trigger matches (except
+#: REPLICA_KINDS, which only ever match the ``router`` site)
+SITES = ("train_step", "checkpoint", "serving_step", "launcher", "router")
 
 
 class InjectedFault(RuntimeError):
@@ -173,6 +182,8 @@ class FaultInjector:
         if e.fired:
             return False
         if e.site is not None and e.site != site:
+            return False
+        if e.kind in REPLICA_KINDS and site != "router":
             return False
         if e.trigger == "step":
             return step is not None and step >= e.at
@@ -298,7 +309,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in entries:
             unit = "s" if e.trigger == "time" else ""
             scope = f" (site {e.site})" if e.site else ""
-            print(f"  at {e.trigger}={e.at:g}{unit}: {e.kind}{scope}")
+            note = ""
+            if e.kind == "replica_kill":
+                note = (" — fleet drill: the serving router kills one "
+                        "replica (DSTPU_CHAOS_REPLICA names it; default "
+                        "busiest); its streams fail over gapless")
+            elif e.kind == "replica_slow":
+                note = (" — fleet drill: the serving router degrades one "
+                        "replica's pump; hedged dispatch races a healthy "
+                        "replica for its queued-too-long requests")
+            print(f"  at {e.trigger}={e.at:g}{unit}: {e.kind}{scope}{note}")
         if args.explain:
             return 0
         print("dstpu-chaos: no command given (append -- prog args...)",

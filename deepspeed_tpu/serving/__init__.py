@@ -15,8 +15,11 @@ from deepspeed_tpu.serving.metrics import Histogram, ServingMetrics  # noqa: F40
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue  # noqa: F401
 from deepspeed_tpu.serving.request import Request, RequestState  # noqa: F401
+from deepspeed_tpu.serving.router import (CircuitBreaker, LocalReplica,  # noqa: F401
+                                          Router, RouterRequest)
 from deepspeed_tpu.serving.scheduler import TokenBudgetPolicy  # noqa: F401
 
 __all__ = ["ServingFrontend", "adopt_cached", "Request", "RequestState",
            "AdmissionQueue", "AdmissionError", "PrefixCache", "PrefixMatch",
-           "TokenBudgetPolicy", "ServingMetrics", "Histogram"]
+           "TokenBudgetPolicy", "ServingMetrics", "Histogram",
+           "Router", "RouterRequest", "LocalReplica", "CircuitBreaker"]

@@ -276,10 +276,17 @@ class ServingFrontend:
                 "kv_exhausted", f"need {need} pages, {avail} reclaimable")
         self._slo_check(req, now)
         try:
-            self.queue.submit(req, now)
+            victim = self.queue.submit(req, now)
         except AdmissionError:
             self.metrics.bump("rejected_queue_full")
             raise
+        if victim is not None:
+            # the queue shed a past-deadline entry to make room; give it
+            # the same terminal treatment shed_expired victims get — a
+            # "deadline" finish the client can observe and a shed count
+            victim.finish_ts = now
+            self.metrics.bump("shed")
+            self._trace_lifecycle(victim, "deadline", now)
         self.metrics.bump("admitted")
         return req
 
@@ -397,8 +404,13 @@ class ServingFrontend:
                 # chaos hook: an engine_error entry raises HERE so the
                 # injected fault exercises the same except-path a real
                 # engine failure takes
+                # advisory=False: this hook acts on no advisory kinds, so
+                # fleet-scoped entries (replica_kill/replica_slow) stay
+                # pending for the router's hook instead of being consumed
+                # and dropped by a replica's own pump
                 fault_injector.fire("serving_step",
-                                    serving_step=self._pump_steps)
+                                    serving_step=self._pump_steps,
+                                    advisory=False)
                 out = self.engine.step_with_budget(budget=self.token_budget,
                                                    mode=self.mode,
                                                    max_steps=k,

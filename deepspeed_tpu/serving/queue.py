@@ -42,11 +42,18 @@ class AdmissionQueue:
     def depth(self) -> int:
         return len(self._q)
 
-    def submit(self, req: Request, now: float) -> None:
+    def submit(self, req: Request, now: float) -> Optional[Request]:
+        """Enqueue ``req``; returns the past-deadline victim shed to make
+        room (None when the queue had space). The caller owns the
+        victim's terminal accounting — it is already in state SHED with
+        reason ``"deadline"``, but only the frontend can emit its finish
+        and bump the shed counter."""
+        victim = None
         if len(self._q) >= self.max_depth:
             # backpressure, not buffering: shed a past-deadline entry to
             # make room before rejecting live work
-            if not self._shed_one(now):
+            victim = self._shed_one(now)
+            if victim is None:
                 req.state = RequestState.REJECTED
                 req.finish_reason = "queue_full"
                 raise AdmissionError(
@@ -55,6 +62,7 @@ class AdmissionQueue:
         req.state = RequestState.QUEUED
         self._q.append(req)
         self._seq += 1
+        return victim
 
     def _shed_one(self, now: float) -> Optional[Request]:
         """Shed the LOWEST-priority expired entry, if any."""

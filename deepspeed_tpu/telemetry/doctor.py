@@ -166,7 +166,10 @@ def analyze(dumps: List[Dict[str, Any]],
         for e in doc.get("events", []):
             if e.get("kind") in ("fault_injected", "recovery",
                                  "ckpt_fallback", "serving_engine_fault",
-                                 "preemption"):
+                                 "preemption", "router_replica_kill",
+                                 "router_replica_slow", "router_failover",
+                                 "router_breaker", "router_drain_start",
+                                 "router_drained"):
                 recovery_timeline.append({**e, "host": _host_name(doc, i)})
     recovery_timeline.sort(key=lambda e: (e.get("ts", 0.0),
                                           e.get("step") or 0))
@@ -382,6 +385,11 @@ def render(report: Dict[str, Any]) -> str:
             what = (e.get("spec") or e.get("recovery")
                     or e.get("checkpoint_tag") or e.get("bad_tag")
                     or e.get("error") or "")
+            if e.get("replica"):
+                # fleet events name their replica — "which replica died
+                # and who answered" reads straight off the timeline
+                dst = f" -> {e['to']}" if e.get("to") else ""
+                what = f"replica={e['replica']}{dst} {what}".rstrip()
             out.append(f"  step {e.get('step')!s:>8} {e['host']:<24}"
                        f"{kind:<22}{what}")
         if len(rt) > 50:

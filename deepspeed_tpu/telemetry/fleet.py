@@ -29,6 +29,7 @@ Usage::
 
 import argparse
 import json
+import re
 import sys
 import time
 import urllib.error
@@ -56,6 +57,12 @@ H_TOKENS = ("serving/tokens_out", "train/tokens")
 H_MFU = ("train/mfu", "roofline/step_mfu")
 H_QUEUE = ("serving/queue_depth:mean", "serving/queue_depth")
 H_BURN = ("slo/worst_burn",)
+
+#: numeric replica-state encoding published by the serving router
+#: (``router/replica/{name}/state`` gauges) → display names
+ROUTER_STATES = {0.0: "healthy", 1.0: "half-open", 2.0: "open",
+                 3.0: "draining", 4.0: "dead"}
+_ROUTER_STATE_RE = re.compile(r"^router_replica_(.+)_state$")
 
 
 def parse_prometheus_text(text: str) -> Dict[str, Any]:
@@ -170,11 +177,25 @@ class HostSample:
             "tok_rate": self._rate(TOKEN_COUNTERS),
             "burn": _first(m, BURN_GAUGES),
             "stale_s": None if self.ts is None else max(0.0, now - self.ts),
+            "router": router_states(m),
         }
 
 
 def _ms(v: Optional[float]) -> Optional[float]:
     return None if v is None else v * 1000.0
+
+
+def router_states(metrics: Dict[str, Any]) -> Optional[Dict[str, str]]:
+    """Per-replica router state from a host's parsed exposition
+    (``router_replica_<name>_state`` gauges); None when the host does
+    not run a router."""
+    states = {}
+    for key, val in metrics.items():
+        m = _ROUTER_STATE_RE.match(key)
+        if m and isinstance(val, (int, float)):
+            states[m.group(1)] = ROUTER_STATES.get(float(val),
+                                                   f"state_{val:g}")
+    return dict(sorted(states.items())) or None
 
 
 def _http_get(url: str, timeout: float) -> Tuple[int, str]:
@@ -186,8 +207,14 @@ def _http_get(url: str, timeout: float) -> Tuple[int, str]:
 
 
 def poll_host(sample: HostSample, timeout: float = DEFAULT_TIMEOUT_S,
-              clock=time.time) -> HostSample:
-    """Refresh one live host sample from /metrics + /healthz."""
+              clock=time.monotonic) -> HostSample:
+    """Refresh one live host sample from /metrics + /healthz.
+
+    ``clock`` stamps the sample time used for staleness and rate math;
+    it defaults to ``time.monotonic`` so an NTP wall-clock step between
+    polls can neither inflate staleness nor flip a rate negative — the
+    serving router's circuit breaker reuses this poller, and a breaker
+    that flaps on clock adjustments would drain a healthy replica."""
     base = sample.target if "://" in sample.target \
         else f"http://{sample.target}"
     sample.prev_metrics, sample.prev_ts = sample.metrics, sample.ts
@@ -300,6 +327,9 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
         lines.append(" ".join(cells))
         if r.get("reason"):
             lines.append(f"    └─ {r['reason']}")
+        if r.get("router"):
+            pairs = " ".join(f"{n}={s}" for n, s in r["router"].items())
+            lines.append(f"    └─ router: {pairs}")
     degraded = sum(1 for r in rows if r["status"] not in ("ok",))
     lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
                  f"(* = interval percentile, ms)")
@@ -335,7 +365,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.history:
             rows = rows_from_history(args.history)
         else:
-            now = time.time()
+            # same monotonic clock poll_host stamps samples with — the
+            # staleness column must not move when NTP steps the wall clock
+            now = time.monotonic()
             rows = [poll_host(s, timeout=args.timeout).row(now)
                     for s in samples]
         publish_fleet_gauges(rows)

@@ -35,8 +35,8 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "comm", "compile", "dispatch", "fleet", "mem",
-               "overlap", "resilience", "roofline", "serving", "slo",
-               "train")
+               "overlap", "resilience", "roofline", "router", "serving",
+               "slo", "train")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
