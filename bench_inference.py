@@ -294,6 +294,182 @@ def bench_router(args) -> None:
     print(json.dumps(result))
 
 
+def bench_diurnal(args) -> None:
+    """elasticity scenario: a DISAGGREGATED prefill/decode fleet under a
+    diurnal load swing (10x between trough and peak) with the SLO-driven
+    autoscaler sizing each pool — replica counts must follow the curve
+    while TTFT p95 holds — plus a chaos ``replica_kill`` landing on a
+    replica MID-SCALE-DOWN (the drain window), which must still converge
+    with the faults==recoveries ledger balanced. Prints ONE JSON line
+    with per-phase pool sizes, TTFT p95, scale events, handoff counts
+    and the ledger."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.resilience.faults import fault_injector
+    from deepspeed_tpu.serving import (Autoscaler, LocalReplica, Router,
+                                       ServingFrontend)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size = args.size or ("1b" if on_tpu else "tiny")
+    ds.build_mesh(data=1, devices=jax.devices()[:1])
+    seq_cap = 256
+    model = llama3_config(size, max_seq_len=seq_cap, tie_embeddings=True)
+    dtype = "bfloat16" if on_tpu else "float32"
+    params = init_params(model, jax.random.PRNGKey(0))
+    new = max(2, min(args.new_tokens, 8))
+    eng_cfg = {"dtype": dtype, "num_blocks": 96, "block_size": 8,
+               "max_seq_len": seq_cap, "prefill_chunk": 16,
+               "max_batch_tokens": 256, "max_sequences": 16,
+               "use_pallas": (False if args.no_pallas else None)}
+
+    def make_replica(pool: str, name: str) -> LocalReplica:
+        eng = RaggedInferenceEngineTPU(model, dict(eng_cfg),
+                                       params=params)
+        return LocalReplica(name, ServingFrontend(eng, max_queue=256),
+                            pool=pool)
+
+    spawned = {"prefill": 0, "decode": 0}
+
+    def spawn(pool: str) -> LocalReplica:
+        spawned[pool] += 1
+        return router.add_replica(
+            make_replica(pool, f"{pool[0]}{spawned[pool]}"))
+
+    router = Router([make_replica("prefill", "p0"),
+                     make_replica("decode", "d0")], hedge=False)
+    scaler = Autoscaler(router, spawn_fn=spawn,
+                        prefill_min=1, prefill_max=3,
+                        decode_min=1, decode_max=4,
+                        queue_high=2.0, idle_s=0.3, cooldown_s=0.2,
+                        evaluate_every_s=0.05, drain_deadline_s=15.0)
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, model.vocab_size, size=8)
+
+    def prompt():
+        return [int(t) for t in np.concatenate(
+            [prefix, rng.integers(0, model.vocab_size, size=4)])]
+
+    # warm every compile bucket at floor size before measuring — the
+    # drill times elasticity and recovery, not XLA
+    warm = [router.submit(prompt(), max_new_tokens=new) for _ in range(4)]
+    router.run_until_idle(wall_timeout_s=600.0)
+    assert all(w.finish_reason in ("length", "eos") for w in warm)
+
+    c = telemetry.registry.counter
+    base = {k: c(k).value for k in (
+        "resilience/faults_injected", "resilience/recoveries",
+        "autoscale/scale_ups", "autoscale/scale_downs",
+        "handoff/completed", "router/failovers")}
+
+    def pool_sizes():
+        return {p: len(router.pool_members(p))
+                for p in ("prefill", "decode")}
+
+    def drive(idle_spin_s: float, arm_kill: bool) -> bool:
+        """Poll router + autoscaler until streams finish AND the fleet
+        has idled ``idle_spin_s`` (the window where idle scale-down
+        fires). ``arm_kill`` arms a replica_kill against the FIRST
+        replica seen draining — the mid-scale-down chaos drill."""
+        armed = False
+        t_idle = None
+        while True:
+            busy = router.poll()
+            scaler.maybe_evaluate()
+            if arm_kill and not armed and router._draining:
+                victim = sorted(router._draining)[0]
+                os.environ["DSTPU_CHAOS_REPLICA"] = victim
+                fault_injector.arm(
+                    f"serving_step:{router._polls + 1}:"
+                    f"replica_kill:router", _env=False)
+                armed = True
+            if busy:
+                t_idle = None
+            else:
+                now = time.monotonic()
+                if t_idle is None:
+                    t_idle = now
+                if now - t_idle >= idle_spin_s:
+                    return armed
+            time.sleep(0.001)
+
+    # the diurnal curve: trough → ramp → 10x peak → trough again (the
+    # final trough spins long enough for idle scale-down + the kill)
+    phases = [("night", 2, 0.0), ("morning", 6, 0.0),
+              ("peak", 20, 0.0), ("evening", 2, 1.2)]
+    t0 = time.perf_counter()
+    all_reqs = []
+    phase_rows = []
+    killed = False
+    for name, n_req, idle_spin in phases:
+        reqs = [router.submit(prompt(), max_new_tokens=new)
+                for _ in range(n_req)]
+        all_reqs += reqs
+        killed |= drive(idle_spin, arm_kill=(name == "evening"
+                                             and not killed))
+        phase_rows.append({
+            "phase": name, "requests": n_req, "pools": pool_sizes(),
+            "ttft_p95_s": (round(router.ttft.percentile(95), 4)
+                           if router.ttft.count else None)})
+    # convergence: the drain set empties (even with the kill landing
+    # mid-drain) and the recovery ledger closes
+    deadline = time.monotonic() + 60.0
+    while (router._draining or router._pending_recovery or
+           router._pending_handoff) and time.monotonic() < deadline:
+        router.poll()
+        time.sleep(0.002)
+    wall = time.perf_counter() - t0
+    fault_injector.disarm()
+    os.environ.pop("DSTPU_CHAOS_REPLICA", None)
+    converged = not router._draining and not router._pending_recovery
+    toks = sum(len(r.tokens_out) for r in all_reqs)
+    faults = int(c("resilience/faults_injected").value -
+                 base["resilience/faults_injected"])
+    recoveries = int(c("resilience/recoveries").value -
+                     base["resilience/recoveries"])
+    peak_pools = max(sum(row["pools"].values()) for row in phase_rows)
+    result = {
+        "metric": f"diurnal elasticity llama3-{size}: disagg "
+                  f"prefill/decode fleet, "
+                  f"{sum(n for _, n, _ in phases)} req over "
+                  f"{len(phases)} phases (10x swing), autoscaler + "
+                  f"mid-scale-down replica_kill",
+        "value": round(toks / wall, 2),
+        "unit": "gen tokens/s (autoscaled fleet)",
+        "vs_baseline": 1.0,
+        "extra": {
+            "phases": phase_rows,
+            "final_pools": pool_sizes(),
+            "peak_fleet": peak_pools,
+            "scale_ups": int(c("autoscale/scale_ups").value -
+                             base["autoscale/scale_ups"]),
+            "scale_downs": int(c("autoscale/scale_downs").value -
+                               base["autoscale/scale_downs"]),
+            "handoffs": int(c("handoff/completed").value -
+                            base["handoff/completed"]),
+            "failovers": int(c("router/failovers").value -
+                             base["router/failovers"]),
+            "completed": sum(r.finish_reason in ("length", "eos")
+                             for r in all_reqs),
+            "requests": len(all_reqs),
+            "kill_armed": killed,
+            "converged": converged,
+            "ttft_p95_s": (round(router.ttft.percentile(95), 4)
+                           if router.ttft.count else None),
+            "ledger": {"faults": faults, "recoveries": recoveries,
+                       "balanced": faults == recoveries},
+            "slo": _slo_extra(),
+        },
+    }
+    router.close()
+    print(json.dumps(result))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default=None)
@@ -309,13 +485,17 @@ def main() -> None:
                     help="weight-only quantized serving (bare flag = "
                          "int8; int4 quarters the decode weight fetch)")
     ap.add_argument("--scenario", default="stream",
-                    choices=("stream", "shared_prefix_stream", "router"),
+                    choices=("stream", "shared_prefix_stream", "router",
+                             "diurnal"),
                     help="stream: ragged vs padded request stream; "
                          "shared_prefix_stream: serving frontend with "
                          "the radix prefix cache on vs off over "
                          "50%%-shared prompts; router: the stream over "
                          "--replicas N fault-tolerant replicas, "
-                         "optionally under a --chaos plan")
+                         "optionally under a --chaos plan; diurnal: "
+                         "disaggregated prefill/decode fleet under a "
+                         "10x load swing with the autoscaler sizing "
+                         "each pool and a replica killed mid-scale-down")
     ap.add_argument("--replicas", type=int, default=3,
                     help="router scenario: replica pool size")
     ap.add_argument("--chaos", default=None, metavar="PLAN",
@@ -341,6 +521,8 @@ def main() -> None:
         return bench_shared_prefix(args)
     if args.scenario == "router":
         return bench_router(args)
+    if args.scenario == "diurnal":
+        return bench_diurnal(args)
 
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -529,6 +529,47 @@ class RouterConfig(TPUConfigModel):
     chaos_slow_s: float = Field(default=0.25, ge=0)
 
 
+class AutoscaleConfig(TPUConfigModel):
+    """``"autoscale"`` block → serving/autoscaler.py (SLO-driven fleet
+    elasticity; docs/serving.md "Disaggregated pools & autoscaling").
+    Every knob has a same-named ``Autoscaler(...)`` kwarg."""
+    #: master switch — off, the fleet keeps its launch size
+    enabled: bool = False
+    #: per-pool replica floor/ceiling (the ``any`` pool of a monolithic
+    #: fleet uses min(floors)..max(ceilings))
+    prefill_min: int = Field(default=1, ge=0)
+    prefill_max: int = Field(default=4, ge=1)
+    decode_min: int = Field(default=1, ge=0)
+    decode_max: int = Field(default=8, ge=1)
+    #: mean in-flight requests per replica past which the pool grows
+    #: (the queueing knee: beyond it TTFT grows super-linearly)
+    queue_high: float = Field(default=4.0, gt=0)
+    #: a pool at zero load this long shrinks toward its floor
+    idle_s: float = Field(default=5.0, gt=0)
+    #: per-pool freeze after any scale action (flapping guard)
+    cooldown_s: float = Field(default=10.0, ge=0)
+    #: decision cadence for ``maybe_evaluate``
+    evaluate_every_s: float = Field(default=1.0, gt=0)
+    #: ``slo/worst_burn`` at or above this adds capacity even before
+    #: queue depth shows the pressure
+    burn_threshold: float = Field(default=1.0, gt=0)
+    #: scale-down drain deadline — stragglers past it fail over with
+    #: the token fold instead of pinning the replica open
+    drain_deadline_s: float = Field(default=30.0, gt=0)
+
+    @model_validator(mode="after")
+    def _floors_below_ceilings(self) -> "AutoscaleConfig":
+        if self.prefill_min > self.prefill_max:
+            raise ValueError(
+                f"autoscale.prefill_min ({self.prefill_min}) > "
+                f"autoscale.prefill_max ({self.prefill_max})")
+        if self.decode_min > self.decode_max:
+            raise ValueError(
+                f"autoscale.decode_min ({self.decode_min}) > "
+                f"autoscale.decode_max ({self.decode_max})")
+        return self
+
+
 class ResilienceConfig(TPUConfigModel):
     """``"resilience"`` block → deepspeed_tpu/resilience (fault injection
     + recovery policy; docs/resilience.md). The fault plan makes chaos
@@ -677,6 +718,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     slo: SLOConfig = Field(default_factory=SLOConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
     router: RouterConfig = Field(default_factory=RouterConfig)
+    autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)

@@ -689,6 +689,54 @@ class RaggedInferenceEngineTPU:
             jnp.asarray([dst], jnp.int32))
         return dst
 
+    def export_pages(self, blocks: List[int]) -> Dict[str, np.ndarray]:
+        """Device→host gather of whole KV pages, every layer's region.
+
+        The serialization half of prefill→decode page handoff
+        (serving/handoff.py): ``blocks`` are layer-relative page ids
+        (the same ids page tables hold); the flat pool stores layer
+        ``l``'s copy of page ``b`` at ``l*(nb+1)+b``, so one fancy-index
+        gather per {k, v} pulls all ``L`` copies at once. Returns
+        ``{"k", "v"}`` as ``[kvh, L, m, bs, dh]`` host arrays — the
+        importing engine must have identical model geometry (it checks).
+        """
+        L = self.model_config.num_layers
+        stride = self.arena["k"].shape[1] // L          # nb + 1
+        ids = np.asarray(blocks, np.int32)
+        idx = (np.arange(L, dtype=np.int32)[:, None] * stride +
+               ids[None, :]).reshape(-1)
+        out = {}
+        for key in ("k", "v"):
+            kvh, _, bs, dh = self.arena[key].shape
+            flat = np.asarray(self.arena[key][:, idx])  # [kvh, L*m, bs, dh]
+            out[key] = flat.reshape(kvh, L, len(blocks), bs, dh)
+        return out
+
+    def import_pages(self, pages: Dict[str, np.ndarray],
+                     blocks: List[int]) -> None:
+        """Scatter pages from :meth:`export_pages` into this engine's
+        arena at the (already-allocated, caller-owned) page ids
+        ``blocks`` — the adoption half of page handoff. Raises
+        ``ValueError`` on a geometry mismatch rather than silently
+        writing garbage KV."""
+        L = self.model_config.num_layers
+        stride = self.arena["k"].shape[1] // L
+        ids = np.asarray(blocks, np.int32)
+        idx = (np.arange(L, dtype=np.int32)[:, None] * stride +
+               ids[None, :]).reshape(-1)
+        for key in ("k", "v"):
+            kvh, _, bs, dh = self.arena[key].shape
+            want = (kvh, L, len(blocks), bs, dh)
+            got = tuple(pages[key].shape)
+            if got != want:
+                raise ValueError(
+                    f"page bundle {key!r} shape {got} does not fit this "
+                    f"arena (want {want}) — replicas must share model "
+                    f"geometry")
+            data = jnp.asarray(pages[key], self.arena[key].dtype) \
+                .reshape(kvh, L * len(blocks), bs, dh)
+            self.arena[key] = self.arena[key].at[:, idx].set(data)
+
     def _buckets(self, batch: RaggedBatch):
         nb = _bucket(len(batch.uids))
         c = batch.token_ids.shape[1]

@@ -29,7 +29,7 @@ import socket
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -177,7 +177,8 @@ class ReplicaPoolAgent:
 
     def __init__(self, cmd: List[str], n: int, base_port: int = 0,
                  env: Optional[Dict[str, str]] = None,
-                 max_restarts: int = 2, restart_window_s: float = 300.0):
+                 max_restarts: int = 2, restart_window_s: float = 300.0,
+                 heartbeat_dir: Optional[str] = None):
         if n < 1:
             raise ValueError("pool needs at least one replica")
         self.cmd = cmd
@@ -186,13 +187,40 @@ class ReplicaPoolAgent:
         self.env = {**os.environ, **(env or {})}
         self.max_restarts = max_restarts
         self.restart_window_s = restart_window_s
+        #: one heartbeat JSON per replica under this dir (doctor input)
+        self.heartbeat_dir = heartbeat_dir
         self._children: Dict[str, Optional[subprocess.Popen]] = {
             name: None for name in self.names}
         self._restart_times: Dict[str, List[float]] = {
             name: [] for name in self.names}
         #: replicas deliberately downed (kill/stop): never restarted
         self._downed: set = set()
+        #: replicas in graceful scale-down: SIGTERM only lands after the
+        #: router has drained them; heartbeats read ``draining`` so
+        #: dstpu-top/doctor never mistake an intentional shrink for a
+        #: crash loop
+        self._draining: set = set()
         self.restarts = 0
+        self._next_idx = n
+
+    def _beat(self, name: str, phase: str, **extra) -> None:
+        """Per-replica agent heartbeat (atomic write, best effort) —
+        the LaunchAgent._beat contract, one file per replica under
+        ``heartbeat_dir``."""
+        if not self.heartbeat_dir:
+            return
+        try:
+            doc = {"hostname": socket.gethostname(), "pid": os.getpid(),
+                   "agent": True, "replica": name, "phase": phase,
+                   "ts": time.time(), **extra}
+            os.makedirs(self.heartbeat_dir, exist_ok=True)
+            path = os.path.join(self.heartbeat_dir, f"{name}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, path)
+        except Exception:
+            pass
 
     def _spawn(self, name: str) -> subprocess.Popen:
         i = self.names.index(name)
@@ -221,10 +249,23 @@ class ReplicaPoolAgent:
     def poll(self) -> Dict[str, str]:
         """One supervision sweep: restart dead replicas inside their
         rolling budget; returns per-replica phase (``running`` |
-        ``restarting`` | ``down`` | ``crash_loop``)."""
+        ``restarting`` | ``down`` | ``crash_loop`` | ``draining``).
+        A draining replica is NEVER restarted — it is leaving on
+        purpose; if it dies mid-drain (chaos) it is simply down and the
+        router's failover owns its streams."""
         phases: Dict[str, str] = {}
         now = time.monotonic()
-        for name, child in self._children.items():
+        for name, child in list(self._children.items()):
+            if name in self._draining:
+                if child is not None and child.poll() is not None:
+                    self._draining.discard(name)
+                    self._downed.add(name)
+                    phases[name] = "down"
+                    self._beat(name, "down", rc=child.returncode)
+                else:
+                    phases[name] = "draining"
+                    self._beat(name, "draining")
+                continue
             if name in self._downed:
                 phases[name] = "down"
                 continue
@@ -236,6 +277,8 @@ class ReplicaPoolAgent:
                 if now - t <= self.restart_window_s]
             if len(times) >= self.max_restarts:
                 phases[name] = "crash_loop"
+                self._beat(name, "crash_loop",
+                           restarts_in_window=len(times))
                 continue
             rc = child.returncode if child is not None else None
             logger.warning(f"replica pool: {name} exited rc={rc}; "
@@ -244,7 +287,62 @@ class ReplicaPoolAgent:
             self.restarts += 1
             self._spawn(name)
             phases[name] = "restarting"
+            self._beat(name, "restarting", rc=rc,
+                       restarts_in_window=len(times))
         return phases
+
+    # -- elastic scale-up / scale-down --------------------------------------
+
+    def add_replica(self) -> str:
+        """Scale-up: spawn one more replica and return its name (the
+        autoscaler's ``spawn_fn`` seam for process pools). Names never
+        recycle — ``r<next>`` keeps doctor timelines unambiguous."""
+        name = f"r{self._next_idx}"
+        self._next_idx += 1
+        self.names.append(name)
+        self._children[name] = None
+        self._restart_times[name] = []
+        self._spawn(name)
+        self._beat(name, "running")
+        return name
+
+    def begin_drain(self, name: str) -> None:
+        """Mark ``name`` as gracefully scaling down (the autoscaler's
+        ``drain_fn`` seam). The process keeps running — the router is
+        still finishing or failing over its streams — but heartbeats
+        and :meth:`poll` read ``draining``, and only
+        :meth:`finish_drain` / :meth:`stop` send the SIGTERM."""
+        if name not in self._children:
+            raise KeyError(f"no replica named {name!r}")
+        if name in self._downed:
+            return
+        self._draining.add(name)
+        self._beat(name, "draining")
+
+    def finish_drain(self, name: str, grace_s: float = 5.0) -> None:
+        """Complete a scale-down: the router drained ``name`` (no
+        streams assigned, KV released) — now SIGTERM its process group,
+        escalating to SIGKILL past ``grace_s``. The slot stays down."""
+        if name not in self._draining:
+            raise KeyError(f"{name!r} is not draining")
+        self._draining.discard(name)
+        self._downed.add(name)
+        child = self._children.get(name)
+        self._beat(name, "down", drained=True)
+        if child is None or child.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(child.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        try:
+            child.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(child.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            child.wait()
 
     def kill(self, name: str, restart: bool = False) -> None:
         """SIGKILL one replica's process group (chaos ``replica_kill``
@@ -262,9 +360,28 @@ class ReplicaPoolAgent:
                 pass
             child.wait()
 
-    def stop(self, grace_s: float = 5.0) -> None:
-        """SIGTERM the pool (drain window), then SIGKILL stragglers."""
+    def stop(self, grace_s: float = 5.0,
+             drain: Optional[Callable[[str], None]] = None) -> None:
+        """Stop the pool with drain-before-SIGTERM ordering: every live
+        replica is marked ``draining`` first (heartbeats say so, not
+        ``crash_loop``), the ``drain`` callback — typically
+        ``router.drain`` — gets each name so in-flight streams finish
+        or fail over, and only then does SIGTERM land (SIGKILL for
+        stragglers past ``grace_s``)."""
+        for name, child in self._children.items():
+            if name in self._downed or child is None or \
+                    child.poll() is not None:
+                continue
+            self._draining.add(name)
+            self._beat(name, "draining")
+            if drain is not None:
+                try:
+                    drain(name)
+                except Exception as e:
+                    logger.warning(f"replica pool: drain callback for "
+                                   f"{name} failed: {e}")
         self._downed.update(self.names)
+        self._draining.clear()
         live = [c for c in self._children.values()
                 if c is not None and c.poll() is None]
         for c in live:
@@ -282,6 +399,8 @@ class ReplicaPoolAgent:
                 except ProcessLookupError:
                     pass
                 c.wait()
+        for name in self.names:
+            self._beat(name, "down", stopped=True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -51,14 +51,24 @@ ACTION_KINDS = ("preempt", "io_error", "engine_error", "hang")
 #: to the ``router`` site so a replica's own serving pump can never
 #: consume a fleet-scoped fault meant for the tier above it.
 REPLICA_KINDS = ("replica_kill", "replica_slow")
-ADVISORY_KINDS = ("nonfinite_grad", "torn_fragment") + REPLICA_KINDS
+#: KV-page handoff kinds the DISAGGREGATED router acts on while moving a
+#: finished prefill's pages to a decode replica: tear the shipped bundle
+#: (its checksum no longer matches on adopt) or stall the transfer past
+#: its deadline (the bundle never arrives). Advisory, and pinned to the
+#: ``handoff`` site for the same reason replica kinds pin to ``router``:
+#: only the handoff path can answer them (with a decode-side re-prefill).
+HANDOFF_KINDS = ("handoff_torn", "handoff_stall")
+ADVISORY_KINDS = ("nonfinite_grad", "torn_fragment") + REPLICA_KINDS + \
+    HANDOFF_KINDS
 KINDS = ACTION_KINDS + ADVISORY_KINDS
 TRIGGERS = ("step", "serving_step", "time")
 
 #: hook sites a scoped entry (``step:12:io_error:checkpoint``) may name;
 #: unscoped entries fire at any site their trigger matches (except
-#: REPLICA_KINDS, which only ever match the ``router`` site)
-SITES = ("train_step", "checkpoint", "serving_step", "launcher", "router")
+#: REPLICA_KINDS, which only ever match the ``router`` site, and
+#: HANDOFF_KINDS, which only ever match the ``handoff`` site)
+SITES = ("train_step", "checkpoint", "serving_step", "launcher", "router",
+         "handoff")
 
 
 class InjectedFault(RuntimeError):
@@ -184,6 +194,8 @@ class FaultInjector:
         if e.site is not None and e.site != site:
             return False
         if e.kind in REPLICA_KINDS and site != "router":
+            return False
+        if e.kind in HANDOFF_KINDS and site != "handoff":
             return False
         if e.trigger == "step":
             return step is not None and step >= e.at
@@ -318,6 +330,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 note = (" — fleet drill: the serving router degrades one "
                         "replica's pump; hedged dispatch races a healthy "
                         "replica for its queued-too-long requests")
+            elif e.kind == "handoff_torn":
+                note = (" — handoff drill: the prefill→decode KV-page "
+                        "bundle arrives corrupt (checksum mismatch); the "
+                        "decode replica re-prefills instead, zero token "
+                        "loss")
+            elif e.kind == "handoff_stall":
+                note = (" — handoff drill: the prefill→decode KV-page "
+                        "transfer times out (bundle never arrives); the "
+                        "decode replica re-prefills instead, zero token "
+                        "loss")
             print(f"  at {e.trigger}={e.at:g}{unit}: {e.kind}{scope}{note}")
         if args.explain:
             return 0

@@ -10,7 +10,10 @@ the engine stays a pure batch machine; everything traffic-shaped lives in
 this package. See docs/serving.md.
 """
 
+from deepspeed_tpu.serving.autoscaler import Autoscaler  # noqa: F401
 from deepspeed_tpu.serving.frontend import ServingFrontend, adopt_cached  # noqa: F401
+from deepspeed_tpu.serving.handoff import (PageBundle, adopt_bundle,  # noqa: F401
+                                           export_bundle, verify_bundle)
 from deepspeed_tpu.serving.metrics import Histogram, ServingMetrics  # noqa: F401
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue  # noqa: F401
@@ -22,4 +25,6 @@ from deepspeed_tpu.serving.scheduler import TokenBudgetPolicy  # noqa: F401
 __all__ = ["ServingFrontend", "adopt_cached", "Request", "RequestState",
            "AdmissionQueue", "AdmissionError", "PrefixCache", "PrefixMatch",
            "TokenBudgetPolicy", "ServingMetrics", "Histogram",
-           "Router", "RouterRequest", "LocalReplica", "CircuitBreaker"]
+           "Router", "RouterRequest", "LocalReplica", "CircuitBreaker",
+           "PageBundle", "export_bundle", "adopt_bundle", "verify_bundle",
+           "Autoscaler"]

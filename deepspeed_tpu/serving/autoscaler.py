@@ -1,0 +1,214 @@
+"""SLO-driven autoscaler for the serving fleet (``autoscale.*``).
+
+Sizes the router's replica pools — ``prefill`` and ``decode``
+separately on a disaggregated fleet, the single ``any`` pool otherwise —
+from three live signals:
+
+- **admission pressure**: mean in-flight load per live replica against
+  ``queue_high`` (the queueing-theory knee: past it, TTFT grows faster
+  than linearly and hedging only burns capacity);
+- **SLO burn rate**: the ``slo/worst_burn`` gauge from the burn-rate
+  engine — a fast-window breach means the error budget is burning NOW,
+  so capacity is added even before the queue shows it;
+- **sustained idle**: a pool at zero load for ``idle_s`` shrinks toward
+  its floor — diurnal troughs give capacity back.
+
+Scale-up calls ``spawn_fn(pool)`` (which builds a replica and
+``router.add_replica``\\ s it — locally an in-process engine, in a real
+fleet a :class:`~deepspeed_tpu.launcher.agent.ReplicaPoolAgent` spawn).
+Scale-down is SEQUENCED so no stream and no KV page is dropped:
+``router.drain(name, deadline_s)`` stops admissions → in-flight decodes
+finish (stragglers past the deadline fail over with the token fold) →
+the router removes the replica and ``close()`` releases its KV → only
+then does ``drain_fn(name)`` let the process owner SIGTERM it. A
+replica killed mid-scale-down is just a ``replica_kill`` fault: its
+streams fail over and the ledger still closes.
+
+A per-pool ``cooldown_s`` guards against flapping (a scale action
+freezes further actions on that pool until the new capacity has had
+time to move the signals). All decisions publish ``autoscale/*``
+metrics and flight-recorder events so ``dstpu-doctor`` can replay the
+elasticity timeline.
+"""
+
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry.registry import registry as _registry
+from deepspeed_tpu.utils.logging import logger
+
+
+class Autoscaler:
+    """Watches a :class:`~deepspeed_tpu.serving.router.Router` and asks
+    for replicas to be spawned or drained, per pool.
+
+    Pure decision logic over an injectable ``clock`` — the tests drive
+    it on a fake clock; the bench drives it from the request loop.
+    """
+
+    def __init__(self, router, *,
+                 spawn_fn: Callable[[str], Any],
+                 drain_fn: Optional[Callable[[str], Any]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 prefill_min: int = 1, prefill_max: int = 4,
+                 decode_min: int = 1, decode_max: int = 8,
+                 queue_high: float = 4.0,
+                 idle_s: float = 5.0,
+                 cooldown_s: float = 10.0,
+                 evaluate_every_s: float = 1.0,
+                 burn_threshold: float = 1.0,
+                 burn_fn: Optional[Callable[[], float]] = None,
+                 drain_deadline_s: float = 30.0):
+        self.router = router
+        self.spawn_fn = spawn_fn
+        self.drain_fn = drain_fn
+        self.clock = clock
+        self.queue_high = float(queue_high)
+        self.idle_s = float(idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self.evaluate_every_s = float(evaluate_every_s)
+        self.burn_threshold = float(burn_threshold)
+        self.burn_fn = burn_fn
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.floors = {"prefill": int(prefill_min),
+                       "decode": int(decode_min),
+                       "any": int(max(1, min(prefill_min, decode_min)))}
+        self.ceilings = {"prefill": int(prefill_max),
+                         "decode": int(decode_max),
+                         "any": int(max(prefill_max, decode_max))}
+        for p in ("prefill", "decode", "any"):
+            if self.floors[p] > self.ceilings[p]:
+                raise ValueError(
+                    f"autoscale pool {p!r}: floor {self.floors[p]} > "
+                    f"ceiling {self.ceilings[p]}")
+        self._idle_since: Dict[str, Optional[float]] = {}
+        self._last_action: Dict[str, float] = {}
+        self._last_eval: Optional[float] = None
+
+    # -- signals ------------------------------------------------------------
+
+    def _burn(self) -> float:
+        if self.burn_fn is not None:
+            return float(self.burn_fn())
+        v = _registry.gauge("slo/worst_burn").value
+        return float(v) if v is not None else 0.0
+
+    def _pools(self) -> List[str]:
+        return (["prefill", "decode"] if self.router.disaggregated
+                else ["any"])
+
+    # -- decision -----------------------------------------------------------
+
+    def _desired(self, pool: str, members, now: float) -> int:
+        n = len(members)
+        if n == 0:
+            return self.floors[pool]
+        load = sum(r.load() for r in members)
+        target = n
+        if load / n > self.queue_high:
+            # enough replicas that mean load sits at the knee again
+            target = max(target, math.ceil(load / self.queue_high))
+        if self._burn() >= self.burn_threshold:
+            # the error budget is burning: add capacity even before the
+            # queue depth says so (burn leads queue by a fast window)
+            target = max(target, n + 1)
+        if load == 0:
+            t0 = self._idle_since.get(pool)
+            if t0 is None:
+                self._idle_since[pool] = now
+            elif now - t0 >= self.idle_s and target <= n:
+                # shrink only when nothing wants capacity: an SLO burn
+                # against an empty queue (latency, not depth) must win
+                target = min(target, n - 1)
+        else:
+            self._idle_since[pool] = None
+        return max(self.floors[pool], min(self.ceilings[pool], target))
+
+    def _scale_down_victim(self, pool: str, members):
+        # the least-loaded live member drains fastest and strands the
+        # fewest streams behind the drain deadline
+        return min(members, key=lambda r: (r.load(), r.name))
+
+    # -- driver -------------------------------------------------------------
+
+    def maybe_evaluate(self) -> int:
+        """Evaluate at most every ``evaluate_every_s``; returns replicas
+        added minus replicas put into drain (0 when off-cadence)."""
+        now = self.clock()
+        if self._last_eval is not None and \
+                now - self._last_eval < self.evaluate_every_s:
+            return 0
+        return self.evaluate()
+
+    def evaluate(self) -> int:
+        """One scaling decision per pool. Returns net replica delta."""
+        now = self.clock()
+        self._last_eval = now
+        _registry.counter(
+            "autoscale/evaluations",
+            help="autoscaler decision passes").inc()
+        delta = 0
+        for pool in self._pools():
+            members = self.router.pool_members(pool)
+            n = len(members)
+            target = self._desired(pool, members, now)
+            _registry.gauge(
+                f"autoscale/target/{pool}",
+                help="autoscaler's desired replica count").set(target)
+            _registry.gauge(
+                f"autoscale/replicas/{pool}",
+                help="live non-draining replicas in the pool").set(n)
+            if target == n:
+                continue
+            last = self._last_action.get(pool)
+            if last is not None and now - last < self.cooldown_s:
+                continue         # flapping guard: let the last move land
+            if target > n:
+                added = 0
+                for _ in range(target - n):
+                    try:
+                        self.spawn_fn(pool)
+                    except Exception as e:   # noqa: BLE001 — capacity may
+                        logger.warning(      # genuinely be exhausted
+                            "autoscale: spawn for pool %s failed: %s",
+                            pool, e)
+                        break
+                    added += 1
+                if not added:
+                    continue
+                delta += added
+                self._last_action[pool] = now
+                _registry.counter(
+                    "autoscale/scale_ups",
+                    help="replicas added by the autoscaler").inc(added)
+                telemetry.flight_recorder.record_event(
+                    "autoscale_up", pool=pool, added=added,
+                    target=target)
+                logger.warning("autoscale: pool %s %d→%d (+%d)",
+                               pool, n, n + added, added)
+            else:
+                # shrink ONE replica per action — drain is asynchronous
+                # and the next evaluation sees the smaller pool
+                victim = self._scale_down_victim(pool, members)
+                self.router.drain(victim.name,
+                                  deadline_s=self.drain_deadline_s)
+                if self.drain_fn is not None:
+                    try:
+                        self.drain_fn(victim.name)
+                    except Exception as e:   # noqa: BLE001
+                        logger.warning(
+                            "autoscale: drain callback for %s failed: "
+                            "%s", victim.name, e)
+                delta -= 1
+                self._last_action[pool] = now
+                _registry.counter(
+                    "autoscale/scale_downs",
+                    help="replicas drained by the autoscaler").inc()
+                telemetry.flight_recorder.record_event(
+                    "autoscale_down", pool=pool, replica=victim.name,
+                    target=target)
+                logger.warning("autoscale: pool %s %d→%d (draining %s)",
+                               pool, n, n - 1, victim.name)
+        return delta

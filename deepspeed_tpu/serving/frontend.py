@@ -207,6 +207,29 @@ class ServingFrontend:
             self._http.close()
             self._http = None
 
+    def terminate_inflight(self, reason: str = "drained") -> int:
+        """Finish every running AND queued request with ``reason``
+        (terminal state, KV released) — the scale-down path. A client
+        blocked in :meth:`stream` sees its request reach ``done`` and
+        the iterator end, instead of spinning into the stall-timeout
+        ``RuntimeError`` because the replica under it was drained.
+        Returns requests terminated."""
+        now = self.clock()
+        n = 0
+        for req in list(self._running.values()):
+            self._finish(req, reason, RequestState.FINISHED, now)
+            n += 1
+        for req in list(self.queue._q):
+            req.state = RequestState.FINISHED
+            req.finish_reason = reason
+            req.finish_ts = now
+            self._trace_lifecycle(req, reason, now)
+            n += 1
+        self.queue._q.clear()
+        if n:
+            self.metrics.bump("terminated_inflight", n)
+        return n
+
     def _slo_check(self, req: Request, now: float) -> None:
         """Reject at the door when the roofline says the deadline is
         unattainable even on an idle engine: best-case latency =

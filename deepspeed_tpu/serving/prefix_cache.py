@@ -233,6 +233,24 @@ class PrefixCache:
         self.pages_cached -= dropped
         return dropped
 
+    def owned_blocks(self) -> List[int]:
+        """Every physical page id this cache holds a ref on (full trie
+        pages + partial last pages). The handoff/accounting seam: a
+        serialize→adopt→invalidate round trip must leave
+        ``len(owned_blocks()) == pages_cached`` on both sides with no
+        page double-counted."""
+        out: List[int] = []
+
+        def walk(node: _Node) -> None:
+            for rec in node.partials.values():
+                out.append(rec[0])
+            for child in node.children.values():
+                out.append(child.block)
+                walk(child)
+
+        walk(self._root)
+        return out
+
     def evictable_pages(self) -> int:
         """Pages the cache could give back under arena pressure (all of
         them — eviction recurses leaf-inward)."""

@@ -19,8 +19,19 @@ stream against every replica failure mode:
   a p95-derived delay) races a second replica; the first token decides
   the winner and the loser is cancelled;
 - **graceful draining**: ``drain(name)`` stops new admissions, lets
-  in-flight decodes finish on the replica, then removes it without
-  dropping a stream.
+  in-flight decodes finish on the replica (optionally bounded by a
+  deadline that fails the stragglers over), then removes it without
+  dropping a stream — streams it had to cut finish with the honest
+  reason ``"drained"``, never a stall error;
+- **disaggregated prefill/decode pools**: replicas tagged
+  ``pool="prefill"`` / ``pool="decode"`` split the fleet by roofline
+  regime (compute-bound ragged prefill vs bandwidth-bound decode). A
+  request prefills on the prefill pool for exactly one token, then the
+  router ships the prefill replica's radix-cached KV pages to a decode
+  replica (:mod:`deepspeed_tpu.serving.handoff`) and the decode leg
+  aliases them; a torn or stalled bundle (``handoff_torn`` /
+  ``handoff_stall`` faults at the ``handoff`` site) falls back to
+  decode-side re-prefill with zero token loss.
 
 T3's principle — host scheduling off the device critical path — holds
 at fleet scope: each replica pumps its own frontend on its own thread
@@ -160,10 +171,21 @@ class LocalReplica:
     had not delivered are lost, exactly like a SIGKILLed replica. The
     router's failover replay is what makes the client stream gapless
     anyway.
+
+    ``pool`` assigns the replica to the disaggregated tier: ``"prefill"``
+    replicas run prompt prefills (one token out, pages handed off),
+    ``"decode"`` replicas run the decode legs, ``"any"`` (the default)
+    serves both — a pool of all-``"any"`` replicas is the classic
+    homogeneous fleet and nothing about routing changes.
     """
 
-    def __init__(self, name: str, frontend, idle_sleep_s: float = 0.002):
+    def __init__(self, name: str, frontend, idle_sleep_s: float = 0.002,
+                 pool: str = "any"):
+        if pool not in ("any", "prefill", "decode"):
+            raise ValueError(f"bad replica pool {pool!r} "
+                             f"(want any/prefill/decode)")
         self.name = name
+        self.pool = pool
         self.frontend = frontend
         self.lock = threading.RLock()
         self.idle_sleep_s = idle_sleep_s
@@ -227,19 +249,25 @@ class LocalReplica:
 
     def close(self) -> None:
         """Graceful teardown (drain-remove or router shutdown): stop the
-        pump, release every KV page the frontend still owns (running
-        sequences and cached prefix pages), close its endpoint."""
+        pump, terminate any still-attached streams with reason
+        ``"drained"`` (their KV released — a client blocked in
+        ``frontend.stream()`` sees the request finish instead of a
+        stall-timeout RuntimeError), release the cached prefix pages,
+        close the endpoint."""
         self._stop.set()
         if self._started and self._thread.is_alive():
             self._thread.join(timeout=5.0)
         fe = self.frontend
         try:
-            for uid in list(fe._running):
-                try:
-                    fe.engine.flush(uid)
-                except Exception:                    # noqa: BLE001
-                    pass
-            fe._running.clear()
+            if hasattr(fe, "terminate_inflight"):
+                fe.terminate_inflight("drained")
+            else:
+                for uid in list(fe._running):
+                    try:
+                        fe.engine.flush(uid)
+                    except Exception:                # noqa: BLE001
+                        pass
+                fe._running.clear()
             if fe.cache is not None and fe.cache.pages_cached:
                 fe.cache.evict(fe.cache.pages_cached)
             fe.close()
@@ -256,6 +284,9 @@ class _Assignment:
     inner: Request
     dispatch_ts: float
     drained: int = 0                 # inner tokens already delivered
+    #: which disaggregated leg this is: "mono" (homogeneous fleet),
+    #: "prefill" (one-token leg whose pages hand off) or "decode"
+    role: str = "mono"
 
 
 @dataclass
@@ -276,6 +307,12 @@ class RouterRequest:
     #: times this request was re-dispatched after a replica failure
     failovers: int = 0
     hedged: bool = False
+    #: disaggregated lifecycle: "mono" on a homogeneous fleet, else
+    #: "prefill" until the prefill leg finished and its pages handed
+    #: off, then "decode"
+    phase: str = "mono"
+    #: prompt tokens the decode replica served from handed-off pages
+    handoff_tokens: int = 0
 
     submit_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
@@ -357,19 +394,29 @@ class Router:
         bb = float(knob(breaker_backoff_s, "breaker_backoff_s", 1.0))
         bm = float(knob(breaker_backoff_max_s, "breaker_backoff_max_s",
                         30.0))
+        #: breaker knobs, kept so autoscaler-spawned replicas
+        #: (:meth:`add_replica`) get identical health automata
+        self._breaker_kw = dict(failure_threshold=bf, backoff_s=bb,
+                                backoff_max_s=bm)
         for r in self.replicas:
             self.breakers[r.name] = CircuitBreaker(
-                failure_threshold=bf, backoff_s=bb, backoff_max_s=bm,
-                clock=self.clock,
+                clock=self.clock, **self._breaker_kw,
                 on_transition=self._breaker_transition(r.name))
         self._reqs: Dict[int, RouterRequest] = {}
         self._draining: set = set()
+        #: forced-drain deadlines: replica → clock time after which its
+        #: remaining streams are failed over (terminal reason "drained"
+        #: when they cannot be replayed) and the replica is removed
+        self._drain_deadline: Dict[str, float] = {}
         self._polls = 0
         #: chaos-kill recovery ledger: replica → {"t0", "uids"} — closed
         #: (record_recovery) when every failed-over stream completed
         self._pending_recovery: Dict[str, Dict[str, Any]] = {}
         #: chaos-slow ledger: replica → recovery not yet recorded
         self._pending_slow: Dict[str, float] = {}
+        #: handoff-fault ledger: req uid → fallback re-prefill in flight;
+        #: the recovery is recorded when that stream completes
+        self._pending_handoff: Dict[int, Dict[str, Any]] = {}
         #: per-replica tokens delivered to clients (bench attribution)
         self.replica_tokens: Dict[str, int] = {
             r.name: 0 for r in self.replicas}
@@ -435,6 +482,48 @@ class Router:
             self._http.set_degraded(draining, source="router",
                                     reason="failover replays draining")
 
+    # -- pools --------------------------------------------------------------
+
+    @property
+    def disaggregated(self) -> bool:
+        """True when the fleet has BOTH a prefill and a decode pool —
+        requests then run as a prefill leg + KV-page handoff + decode
+        leg. With either pool absent the router behaves exactly as the
+        homogeneous PR-10 fleet."""
+        pools = {r.pool for r in self.replicas if r.alive}
+        return "prefill" in pools and "decode" in pools
+
+    def pool_members(self, pool: str,
+                     live_only: bool = True) -> List[LocalReplica]:
+        """Replicas serving ``pool`` (``"any"`` replicas serve both)."""
+        return [r for r in self.replicas
+                if r.pool in ("any", pool)
+                and (not live_only or
+                     (r.alive and r.name not in self._draining))]
+
+    def add_replica(self, replica) -> LocalReplica:
+        """Grow the fleet at runtime (the autoscaler's scale-up
+        effector). Accepts a :class:`LocalReplica` or a ``(name,
+        frontend)`` pair; the new replica gets a breaker with the same
+        knobs as its peers and starts taking traffic on the next
+        placement decision."""
+        if not isinstance(replica, LocalReplica):
+            name, fe = replica
+            replica = LocalReplica(name, fe)
+        if replica.name in {r.name for r in self.replicas}:
+            raise ValueError(f"replica name {replica.name!r} already "
+                             f"in the pool")
+        self.replicas.append(replica.start())
+        self.breakers[replica.name] = CircuitBreaker(
+            clock=self.clock, **self._breaker_kw,
+            on_transition=self._breaker_transition(replica.name))
+        self.replica_tokens.setdefault(replica.name, 0)
+        telemetry.flight_recorder.record_event(
+            "router_replica_added", replica=replica.name,
+            pool=replica.pool)
+        self._publish_states()
+        return replica
+
     # -- placement ----------------------------------------------------------
 
     def _affinity_key(self, prompt: List[int]) -> bytes:
@@ -446,7 +535,8 @@ class Router:
             hashlib.sha1(key + b"|" + name.encode()).digest()[:8], "big")
 
     def _choose(self, prompt: List[int],
-                exclude: Tuple[str, ...] = ()) -> LocalReplica:
+                exclude: Tuple[str, ...] = (),
+                pool: Optional[str] = None) -> LocalReplica:
         """Prefix-affinity placement: rendezvous (highest-random-weight)
         hash of the prompt's leading tokens over the healthy replicas —
         shared-prefix traffic keeps landing on the same replica, and a
@@ -455,23 +545,29 @@ class Router:
         ``spill_factor``x busier (a warm cache never justifies a hot
         queue). With no CLOSED-breaker replica available, an OPEN
         replica whose backoff elapsed is admitted as the half-open
-        probe; otherwise admission fails loudly."""
-        healthy = [r for r in self.replicas
+        probe; otherwise admission fails loudly. ``pool`` restricts
+        candidates to one disaggregated pool (``"any"`` replicas always
+        qualify)."""
+        cands = (self.replicas if pool is None
+                 else [r for r in self.replicas
+                       if r.pool in ("any", pool)])
+        healthy = [r for r in cands
                    if r.alive and r.name not in self._draining
                    and r.name not in exclude
                    and self.breakers[r.name].state is BreakerState.CLOSED]
         if not healthy:
-            for r in self.replicas:
+            for r in cands:
                 if (r.alive and r.name not in self._draining
                         and r.name not in exclude
                         and self.breakers[r.name].allow_probe()):
                     return r
             raise AdmissionError(
                 "no_healthy_replica",
-                f"{len(self.replicas)} replicas, none admitting "
+                (f"pool {pool!r}: " if pool is not None else "") +
+                f"{len(cands)} replicas, none admitting "
                 f"(states: " + ", ".join(
                     f"{r.name}={self.replica_state(r)}"
-                    for r in self.replicas) + ")")
+                    for r in cands) + ")")
         key = self._affinity_key(prompt)
         chosen = max(healthy, key=lambda r: self._score(key, r.name))
         loads = {r.name: r.load() for r in healthy}
@@ -499,6 +595,7 @@ class Router:
             deadline=(now + timeout if timeout is not None else deadline),
             eos_token_id=eos_token_id)
         req.submit_ts = now
+        req.phase = "prefill" if self.disaggregated else "mono"
         self._dispatch(req, exclude=())
         self._reqs[req.uid] = req
         _registry.counter("router/requests",
@@ -507,19 +604,36 @@ class Router:
 
     def _dispatch(self, req: RouterRequest,
                   exclude: Tuple[str, ...] = (),
-                  hedge: bool = False) -> _Assignment:
+                  hedge: bool = False,
+                  prefer: Optional[LocalReplica] = None) -> _Assignment:
         """(Re-)dispatch ``req`` to a replica. The already-streamed
         tokens fold into the prompt so the replica re-prefills exactly
-        the client-visible decode state — gapless, duplicate-free."""
+        the client-visible decode state — gapless, duplicate-free.
+
+        On a disaggregated fleet the request's ``phase`` picks the pool
+        and the leg: a prefill leg runs for exactly ONE token (the first
+        token is the proof the prompt's KV is complete), then
+        :meth:`_promote_to_decode` hands the pages off; a decode leg
+        runs the remaining budget. ``prefer`` pins the first attempt to
+        one replica (the handoff path adopts pages into a replica
+        BEFORE dispatching to it, so placement must not move)."""
         remaining = req.max_new_tokens - len(req.tokens_out)
         folded = req.prompt + req.tokens_out
+        role, pool, inner_max = "mono", None, remaining
+        if req.phase == "prefill":
+            role, pool, inner_max = "prefill", "prefill", 1
+        elif req.phase == "decode":
+            role, pool = "decode", "decode"
         last_err: Optional[Exception] = None
         tried: Tuple[str, ...] = exclude
-        for _ in range(len(self.replicas)):
-            replica = self._choose(folded, exclude=tried)
+        for _ in range(len(self.replicas) + 1):
+            if prefer is not None:
+                replica, prefer = prefer, None
+            else:
+                replica = self._choose(folded, exclude=tried, pool=pool)
             try:
                 inner = replica.submit(
-                    folded, max_new_tokens=remaining,
+                    folded, max_new_tokens=inner_max,
                     priority=req.priority, deadline=req.deadline,
                     eos_token_id=req.eos_token_id)
             except AdmissionError as e:
@@ -529,7 +643,7 @@ class Router:
                     f"submit rejected: {e.reason}")
                 continue
             assign = _Assignment(replica=replica, inner=inner,
-                                 dispatch_ts=self.clock())
+                                 dispatch_ts=self.clock(), role=role)
             if hedge:
                 req.hedge = assign
             else:
@@ -545,12 +659,14 @@ class Router:
 
     def _chaos_victim(self) -> Optional[LocalReplica]:
         named = os.environ.get("DSTPU_CHAOS_REPLICA")
+        if named:
+            # a NAMED victim is killable even mid-drain — the
+            # scale-down chaos drill targets exactly that window
+            for r in self.replicas:
+                if r.name == named and r.alive:
+                    return r
         cands = [r for r in self.replicas
                  if r.alive and r.name not in self._draining]
-        if named:
-            for r in cands:
-                if r.name == named:
-                    return r
         if not cands:
             return None
         # deterministic: the busiest replica (ties → pool order) — the
@@ -617,17 +733,32 @@ class Router:
                 help="hedge legs that delivered the stream").inc()
             return
         req.failovers += 1
+        # a stream cut because its replica was intentionally drained is
+        # an operator action, not an error: past the retry budget it
+        # finishes with the honest reason "drained", never a stall/error
+        drained = from_name in self._draining or "drain" in reason
         if req.failovers > self.retry_budget:
-            self._finish(req, "error")
-            _registry.counter(
-                "router/errors",
-                help="streams failed after the retry budget").inc()
+            if drained:
+                self._finish(req, "drained")
+                _registry.counter(
+                    "router/drained_streams",
+                    help="streams finished because their replica was "
+                         "drained past the retry budget").inc()
+            else:
+                self._finish(req, "error")
+                _registry.counter(
+                    "router/errors",
+                    help="streams failed after the retry budget").inc()
             return
         try:
             self._dispatch(req, exclude=(from_name,))
         except AdmissionError:
-            self._finish(req, "error")
-            _registry.counter("router/errors").inc()
+            if drained:
+                self._finish(req, "drained")
+                _registry.counter("router/drained_streams").inc()
+            else:
+                self._finish(req, "error")
+                _registry.counter("router/errors").inc()
             return
         _registry.counter(
             "router/failovers",
@@ -730,21 +861,7 @@ class Router:
         active = req.winner or req.primary
         # 2. drain winner tokens to the client view
         if active is not None and active.replica.alive:
-            inner_toks = active.inner.tokens_out
-            if len(inner_toks) > active.drained:
-                new = inner_toks[active.drained:]
-                active.drained = len(inner_toks)
-                if req.first_token_ts is None:
-                    req.first_token_ts = now
-                    self.ttft.record(max(0.0, now - (req.submit_ts or now)))
-                req.tokens_out.extend(int(t) for t in new)
-                req.last_progress_ts = now
-                self.replica_tokens[active.replica.name] = \
-                    self.replica_tokens.get(active.replica.name, 0) + \
-                    len(new)
-                _registry.counter(
-                    "router/tokens_out",
-                    help="tokens delivered to clients").inc(len(new))
+            self._drain_tokens(req, active, now)
         # 3. replica health of the active leg
         if active is not None:
             br = self.breakers[active.replica.name]
@@ -766,12 +883,26 @@ class Router:
                 else:
                     self._fail_assignment(req, active, "stream errored")
                 return
+            if inner.finish_reason == "drained":
+                # the replica cut this leg because it is scaling down —
+                # failover elsewhere, or finish honestly as "drained"
+                self._fail_assignment(req, active, "replica drained")
+                return
             if inner.state is RequestState.SHED:
                 self._finish(req, inner.finish_reason or "deadline")
                 _registry.counter(
                     "router/shed",
                     help="streams shed past their deadline").inc()
                 return
+            if active.role == "prefill":
+                # the prefill leg ran exactly one token — catch any
+                # late-arriving token first, then either finish (eos /
+                # budget done) or hand the KV pages to the decode pool
+                self._drain_tokens(req, active, now)
+                if inner.finish_reason != "eos" and \
+                        len(req.tokens_out) < req.max_new_tokens:
+                    self._promote_to_decode(req, active, now)
+                    return
             self._finish(req, inner.finish_reason or "length")
             _registry.counter(
                 "router/completed",
@@ -827,6 +958,143 @@ class Router:
             return max(0.02, float(self.ttft.percentile(95)))
         return 0.25
 
+    def _drain_tokens(self, req: RouterRequest, assign: _Assignment,
+                      now: float) -> None:
+        """Fold new tokens from ``assign`` into the client view (TTFT on
+        the first, progress stamp, per-replica accounting)."""
+        inner_toks = assign.inner.tokens_out
+        if len(inner_toks) <= assign.drained:
+            return
+        new = inner_toks[assign.drained:]
+        assign.drained = len(inner_toks)
+        if req.first_token_ts is None:
+            req.first_token_ts = now
+            self.ttft.record(max(0.0, now - (req.submit_ts or now)))
+        req.tokens_out.extend(int(t) for t in new)
+        req.last_progress_ts = now
+        self.replica_tokens[assign.replica.name] = \
+            self.replica_tokens.get(assign.replica.name, 0) + len(new)
+        _registry.counter(
+            "router/tokens_out",
+            help="tokens delivered to clients").inc(len(new))
+
+    # -- prefill → decode handoff -------------------------------------------
+
+    def _promote_to_decode(self, req: RouterRequest, active: _Assignment,
+                           now: float) -> None:
+        """The prefill leg delivered its first token — move the request
+        to the decode pool. The happy path ships the prefill replica's
+        radix-cached KV pages (export → checksummed bundle → adopt into
+        the decode arena BEFORE the decode leg dispatches, so its
+        ``adopt_cached`` admission aliases them). The failure domain is
+        handled here too: a torn (``handoff_torn``) or timed-out
+        (``handoff_stall``) bundle adopts nothing and the decode replica
+        re-prefills the folded prompt — recompute, never token loss —
+        and the fallback is ledgered so faults == recoveries closes."""
+        from deepspeed_tpu.serving.handoff import (adopt_bundle,
+                                                   export_bundle,
+                                                   verify_bundle)
+        src = active.replica
+        req.handoff_tokens = len(req.tokens_out)
+        # fault hook: handoff_torn corrupts the bundle in transit,
+        # handoff_stall loses it outright — both land in the fallback
+        torn = stalled = False
+        for kind in fault_injector.fire("handoff",
+                                        serving_step=self._polls):
+            if kind == "handoff_torn":
+                torn = True
+            elif kind == "handoff_stall":
+                stalled = True
+        bundle = None
+        if stalled:
+            _registry.counter(
+                "handoff/stalls",
+                help="page bundles lost in transit (timeout)").inc()
+        else:
+            try:
+                with src.lock:
+                    bundle = export_bundle(src.frontend, req.prompt)
+            except Exception as e:   # noqa: BLE001 — source may be dying
+                logger.warning("handoff: export from %s failed: %s",
+                               src.name, e)
+                bundle = None
+            if torn and bundle is not None:
+                bundle.checksum ^= 0x1
+                _registry.counter(
+                    "handoff/torn",
+                    help="page bundles failing checksum on arrival").inc()
+        # the shipped subtree leaves the source either way: pages that
+        # arrived belong to the decode pool now, pages that didn't are
+        # suspect — over-invalidation costs recompute, never correctness
+        try:
+            with src.lock:
+                cache = getattr(src.frontend, "cache", None)
+                if cache is not None:
+                    cache.invalidate(req.prompt)
+        except Exception:   # noqa: BLE001 — dying source already failed over
+            pass
+        req.phase = "decode"
+        req.primary = None
+        req.winner = None
+        if req.hedge is not None:
+            if req.hedge.replica.alive:
+                req.hedge.replica.cancel(req.hedge.inner)
+            req.hedge = None
+        folded = req.prompt + req.tokens_out
+        fault_kind = ("handoff_torn" if torn
+                      else "handoff_stall" if stalled else None)
+        dec: Optional[LocalReplica] = None
+        adopted = 0
+        if bundle is not None and verify_bundle(bundle):
+            # pick the decode replica FIRST, adopt under its lock, THEN
+            # dispatch pinned to it — dispatch-before-adopt would let the
+            # pump admit the leg before the pages are cached (silent full
+            # re-prefill)
+            try:
+                dec = self._choose(folded, pool="decode")
+                with dec.lock:
+                    adopted = adopt_bundle(dec.frontend, bundle)
+            except AdmissionError:
+                dec = None
+            except Exception as e:   # noqa: BLE001
+                logger.warning("handoff: adopt into %s failed: %s",
+                               dec.name if dec is not None else "?", e)
+                adopted = 0
+        if adopted:
+            _registry.counter(
+                "handoff/completed",
+                help="prefill→decode page handoffs that shipped").inc()
+            _registry.counter(
+                "handoff/pages_shipped",
+                help="KV pages adopted by decode replicas").inc(adopted)
+            _registry.counter(
+                "handoff/bytes_shipped",
+                help="KV bytes adopted by decode replicas").inc(
+                    bundle.nbytes)
+            telemetry.flight_recorder.record_event(
+                "router_handoff", replica=src.name, to=dec.name,
+                pages=adopted, uid=req.uid)
+        elif fault_kind is not None:
+            _registry.counter(
+                "handoff/fallback_reprefills",
+                help="failed handoffs recovered by decode-side "
+                     "re-prefill").inc()
+            self._pending_handoff[req.uid] = {
+                "req": req, "t0": now, "kind": fault_kind,
+                "from": src.name}
+            telemetry.flight_recorder.record_event(
+                "router_handoff_fallback", replica=src.name,
+                fault=fault_kind, uid=req.uid)
+        else:
+            _registry.counter(
+                "handoff/skipped",
+                help="promotions with no cached pages to ship").inc()
+        try:
+            self._dispatch(req, prefer=dec)
+        except AdmissionError:
+            self._finish(req, "error")
+            _registry.counter("router/errors").inc()
+
     def _finish(self, req: RouterRequest, reason: str) -> None:
         for a in (req.primary, req.hedge):
             if a is not None and a.replica.alive and not a.inner.done:
@@ -838,12 +1106,19 @@ class Router:
 
     # -- draining & recovery ledger -----------------------------------------
 
-    def drain(self, name: str) -> None:
+    def drain(self, name: str,
+              deadline_s: Optional[float] = None) -> None:
         """Stop new admissions to ``name``; in-flight decodes finish on
-        it, then :meth:`poll` removes it without dropping a stream."""
+        it, then :meth:`poll` removes it without dropping a stream.
+        With ``deadline_s`` set, streams still assigned past the
+        deadline fail over (token-fold replay) instead of pinning the
+        replica open — the scale-down path uses this so a wedged stream
+        can't block the fleet from shrinking."""
         if name not in {r.name for r in self.replicas}:
             raise KeyError(f"no replica named {name!r}")
         self._draining.add(name)
+        if deadline_s is not None:
+            self._drain_deadline[name] = self.clock() + float(deadline_s)
         _registry.counter("router/drains",
                           help="replicas put into draining").inc()
         telemetry.flight_recorder.record_event("router_drain_start",
@@ -851,20 +1126,45 @@ class Router:
         self._publish_states()
 
     def _sweep_draining(self) -> None:
+        now = self.clock()
         for r in list(self.replicas):
-            if r.name in self._draining and \
-                    self._assigned_count(r) == 0:
+            if r.name not in self._draining:
+                continue
+            if self._assigned_count(r) and \
+                    now >= self._drain_deadline.get(r.name, float("inf")):
+                for req in list(self._reqs.values()):
+                    if req.done:
+                        continue
+                    for a in (req.primary, req.hedge):
+                        if a is not None and a.replica is r:
+                            self._fail_assignment(req, a, "drain deadline")
+            if self._assigned_count(r) == 0:
                 self._draining.discard(r.name)
+                self._drain_deadline.pop(r.name, None)
                 self.replicas.remove(r)
                 _registry.gauge(f"router/replica/{r.name}/state").set(
                     STATE_CODES["dead"])
                 telemetry.flight_recorder.record_event(
-                    "router_drained", replica=r.name)
+                    "router_drained", replica=r.name, pool=r.pool)
                 logger.warning("router: replica %s drained and removed",
                                r.name)
                 r.close()
 
     def _sweep_recoveries(self, now: float) -> None:
+        for uid in list(self._pending_handoff):
+            entry = self._pending_handoff[uid]
+            req = entry["req"]
+            if not req.done:
+                continue
+            del self._pending_handoff[uid]
+            if req.finish_reason == "error":
+                continue     # the fallback itself failed — stays open
+            record_recovery("handoff_reprefill", fault=entry["kind"],
+                            replica=entry["from"], uid=uid,
+                            recovery_s=round(now - entry["t0"], 3))
+            logger.warning("router: %s handoff for uid=%d recovered by "
+                           "decode-side re-prefill in %.3fs",
+                           entry["kind"], uid, now - entry["t0"])
         for name in list(self._pending_recovery):
             entry = self._pending_recovery[name]
             if any(uid in self._reqs and not self._reqs[uid].done
@@ -927,6 +1227,8 @@ class Router:
         return {
             "replicas": {r.name: self.replica_state(r)
                          for r in self.replicas},
+            "pools": {r.name: r.pool for r in self.replicas},
+            "disaggregated": self.disaggregated,
             "requests": int(c("router/requests").value),
             "completed": int(c("router/completed").value),
             "errors": int(c("router/errors").value),
@@ -937,6 +1239,12 @@ class Router:
             "breaker_transitions":
                 int(c("router/breaker_transitions").value),
             "tokens_out": int(c("router/tokens_out").value),
+            "drained_streams": int(c("router/drained_streams").value),
+            "handoffs": int(c("handoff/completed").value),
+            "handoff_pages": int(c("handoff/pages_shipped").value),
+            "handoff_fallbacks":
+                int(c("handoff/fallback_reprefills").value),
+            "handoff_skipped": int(c("handoff/skipped").value),
             "replica_tokens": dict(self.replica_tokens),
             "ttft_p95_s": (round(self.ttft.percentile(95), 4)
                            if self.ttft.count else None),
@@ -957,10 +1265,13 @@ class Router:
 # ---------------------------------------------------------------------------
 
 def _build_local_pool(n: int, size: str, http_ports: bool,
-                      seed: int = 0) -> List[LocalReplica]:
+                      seed: int = 0, pools: Optional[List[str]] = None,
+                      ) -> List[LocalReplica]:
     """N in-process replicas over tiny CPU engines sharing one param
     tree (each replica owns its engine + KV arena, exactly the state a
-    real replica process would lose on a kill)."""
+    real replica process would lose on a kill). ``pools`` assigns each
+    replica's pool (``prefill``/``decode``/``any``) for a disaggregated
+    fleet; default is a monolithic ``any`` pool."""
     import jax
     from deepspeed_tpu.inference.engine_v2 import RaggedInferenceEngineTPU
     from deepspeed_tpu.models.llama import llama3_config
@@ -978,7 +1289,8 @@ def _build_local_pool(n: int, size: str, http_ports: bool,
         eng = RaggedInferenceEngineTPU(cfg, dict(eng_cfg), params=params)
         fe = ServingFrontend(eng, max_queue=256,
                              http_port=(0 if http_ports else None))
-        out.append(LocalReplica(f"r{i}", fe))
+        pool = pools[i] if pools else "any"
+        out.append(LocalReplica(f"r{i}", fe, pool=pool))
     return out
 
 
@@ -1001,6 +1313,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Fault-tolerant multi-replica serving router: local "
                     "pool demo + chaos drill harness.")
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--prefill", type=int, default=0,
+                    help="run a DISAGGREGATED fleet: this many prefill "
+                         "replicas (use with --decode; overrides "
+                         "--replicas)")
+    ap.add_argument("--decode", type=int, default=0,
+                    help="decode-pool replicas for --prefill")
     ap.add_argument("--size", default="tiny")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=8)
@@ -1018,8 +1336,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     import numpy as np
     rng = np.random.default_rng(0)
-    replicas = _build_local_pool(args.replicas, args.size,
-                                 args.replica_http)
+    if args.prefill or args.decode:
+        if not (args.prefill and args.decode):
+            ap.error("--prefill and --decode must both be > 0")
+        pools = (["prefill"] * args.prefill + ["decode"] * args.decode)
+        replicas = _build_local_pool(len(pools), args.size,
+                                     args.replica_http, pools=pools)
+    else:
+        replicas = _build_local_pool(args.replicas, args.size,
+                                     args.replica_http)
     router = Router(replicas, hedge=not args.no_hedge,
                     hedge_delay_s=args.hedge_delay,
                     http_port=args.http_port)
@@ -1034,7 +1359,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         router.run_until_idle(wall_timeout_s=300.0)
     finally:
         wall = time.perf_counter() - t0
-        summary = {"drill": {"replicas": args.replicas,
+        summary = {"drill": {"replicas": len(replicas),
                              "requests": args.requests,
                              "chaos": args.chaos,
                              "wall_s": round(wall, 3)},

@@ -169,7 +169,10 @@ def analyze(dumps: List[Dict[str, Any]],
                                  "preemption", "router_replica_kill",
                                  "router_replica_slow", "router_failover",
                                  "router_breaker", "router_drain_start",
-                                 "router_drained"):
+                                 "router_drained", "router_handoff",
+                                 "router_handoff_fallback",
+                                 "router_replica_added", "autoscale_up",
+                                 "autoscale_down"):
                 recovery_timeline.append({**e, "host": _host_name(doc, i)})
     recovery_timeline.sort(key=lambda e: (e.get("ts", 0.0),
                                           e.get("step") or 0))
@@ -177,8 +180,11 @@ def analyze(dumps: List[Dict[str, Any]],
     n_recoveries = sum(len(h["recoveries"]) for h in hosts)
 
     # -- crash-loop naming from agent heartbeats: a host whose launch
-    # agent is burning its rolling restart budget
+    # agent is burning its rolling restart budget. A "draining" phase
+    # is the OPPOSITE of a crash loop — an intentional scale-down in
+    # flight — and is reported separately so operators don't page on it
     crash_looping = []
+    draining = []
     for hb in heartbeats or []:
         if hb.get("phase") in ("restart_backoff", "crash_loop"):
             crash_looping.append(
@@ -187,6 +193,9 @@ def analyze(dumps: List[Dict[str, Any]],
                  "restarts_in_window": hb.get("restarts_in_window"),
                  "backoff_s": hb.get("backoff_s"),
                  "rc": hb.get("rc")})
+        elif hb.get("phase") == "draining":
+            draining.append({"host": hb.get("hostname"),
+                             "replica": hb.get("replica")})
 
     # -- SLO breach timeline: breach/recovery transitions recorded by
     # the burn-rate engine (telemetry/slo.py); an objective whose latest
@@ -283,7 +292,7 @@ def analyze(dumps: List[Dict[str, Any]],
             "storms": storms, "world": world, "verdict": verdict,
             "slo": {"timeline": slo_timeline, "open": slo_open},
             "recovery_timeline": recovery_timeline,
-            "crash_looping": crash_looping,
+            "crash_looping": crash_looping, "draining": draining,
             "resilience": {"faults_injected": n_faults,
                            "recoveries": n_recoveries,
                            "unrecovered": max(0, n_faults - n_recoveries)}}
@@ -375,7 +384,7 @@ def render(report: Dict[str, Any]) -> str:
             out.append(f"  ... {len(report['anomalies']) - 50} more")
     rt = report.get("recovery_timeline") or []
     res = report.get("resilience") or {}
-    if rt or report.get("crash_looping"):
+    if rt or report.get("crash_looping") or report.get("draining"):
         out.append("")
         out.append(f"recovery timeline ({res.get('faults_injected', 0)} "
                    f"faults injected, {res.get('recoveries', 0)} "
@@ -399,6 +408,11 @@ def render(report: Dict[str, Any]) -> str:
                        f"({c['restarts_in_window']} restarts in window, "
                        f"backoff {c.get('backoff_s')}s, phase "
                        f"{c['phase']})")
+        for d in report.get("draining") or []:
+            who = (f"{d['host']} replica={d['replica']}"
+                   if d.get("replica") else f"{d['host']}")
+            out.append(f"  draining: {who} (intentional scale-down in "
+                       f"flight — not a crash loop)")
     out.append("")
     return "\n".join(out)
 

@@ -63,6 +63,9 @@ H_BURN = ("slo/worst_burn",)
 ROUTER_STATES = {0.0: "healthy", 1.0: "half-open", 2.0: "open",
                  3.0: "draining", 4.0: "dead"}
 _ROUTER_STATE_RE = re.compile(r"^router_replica_(.+)_state$")
+#: autoscaler per-pool gauges (``autoscale/target/{pool}`` and
+#: ``autoscale/replicas/{pool}`` after prometheus name sanitization)
+_AUTOSCALE_RE = re.compile(r"^autoscale_(target|replicas)_(.+)$")
 
 
 def parse_prometheus_text(text: str) -> Dict[str, Any]:
@@ -178,6 +181,7 @@ class HostSample:
             "burn": _first(m, BURN_GAUGES),
             "stale_s": None if self.ts is None else max(0.0, now - self.ts),
             "router": router_states(m),
+            "autoscale": autoscale_targets(m),
         }
 
 
@@ -196,6 +200,21 @@ def router_states(metrics: Dict[str, Any]) -> Optional[Dict[str, str]]:
             states[m.group(1)] = ROUTER_STATES.get(float(val),
                                                    f"state_{val:g}")
     return dict(sorted(states.items())) or None
+
+
+def autoscale_targets(metrics: Dict[str, Any]) -> \
+        Optional[Dict[str, Dict[str, int]]]:
+    """Per-pool ``live/target`` replica counts from a host's parsed
+    exposition (``autoscale_target_<pool>`` / ``autoscale_replicas_``
+    ``<pool>`` gauges); None when the host runs no autoscaler."""
+    pools: Dict[str, Dict[str, int]] = {}
+    for key, val in metrics.items():
+        m = _AUTOSCALE_RE.match(key)
+        if m and isinstance(val, (int, float)):
+            what, pool = m.group(1), m.group(2)
+            pools.setdefault(pool, {})[
+                "target" if what == "target" else "live"] = int(val)
+    return dict(sorted(pools.items())) or None
 
 
 def _http_get(url: str, timeout: float) -> Tuple[int, str]:
@@ -330,6 +349,11 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
         if r.get("router"):
             pairs = " ".join(f"{n}={s}" for n, s in r["router"].items())
             lines.append(f"    └─ router: {pairs}")
+        if r.get("autoscale"):
+            pairs = " ".join(
+                f"{pool}={d.get('live', '?')}/{d.get('target', '?')}"
+                for pool, d in r["autoscale"].items())
+            lines.append(f"    └─ autoscale (live/target): {pairs}")
     degraded = sum(1 for r in rows if r["status"] not in ("ok",))
     lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
                  f"(* = interval percentile, ms)")

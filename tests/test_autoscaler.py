@@ -1,0 +1,421 @@
+"""SLO-driven autoscaler (serving/autoscaler.py) + elastic scale-down.
+
+Decision tests drive the Autoscaler on a fake clock over stub replicas:
+an SLO-burn breach scales up before queue depth shows it, queue pressure
+scales to the knee, sustained idle shrinks to the floor and never below,
+and the per-pool cooldown guards against flapping. The scale-down
+sequencing tests pin the safety ordering — router.drain() → in-flight
+streams finish (or fail over) → replica removed + KV released → only
+then the process-owner callback — and the chaos drill proves a replica
+killed MID-scale-down still converges with a balanced fault ledger.
+ReplicaPoolAgent tests cover the process-pool side: draining replicas
+heartbeat ``draining`` (never ``crash_loop``), die-mid-drain goes to
+``down`` without a restart, and stop() drains before SIGTERM.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from deepspeed_tpu.resilience.faults import fault_injector
+from deepspeed_tpu.serving.autoscaler import Autoscaler
+from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.serving.router import LocalReplica, Router
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    fault_injector.disarm()
+    fault_injector.last_step = None
+    yield
+    fault_injector.disarm()
+    fault_injector.last_step = None
+
+
+def _counter(name: str) -> float:
+    from deepspeed_tpu import telemetry
+    return telemetry.registry.counter(name).value
+
+
+def _gauge(name: str):
+    from deepspeed_tpu import telemetry
+    return telemetry.registry.gauge(name).value
+
+
+class _Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _StubFrontend:
+    def __init__(self):
+        self._running = {}
+        self.queue = []
+        self.submitted = []
+        self.cache = None
+
+    def step(self):
+        return False
+
+    def submit(self, prompt, max_new_tokens=16, priority=0, deadline=None,
+               eos_token_id=None):
+        req = Request(prompt=[int(t) for t in prompt],
+                      max_new_tokens=int(max_new_tokens),
+                      priority=priority, deadline=deadline,
+                      eos_token_id=eos_token_id)
+        req.state = RequestState.RUNNING
+        self.submitted.append(req)
+        return req
+
+    def close(self):
+        pass
+
+
+def _finish(inner, reason="length"):
+    inner.state = RequestState.FINISHED
+    inner.finish_reason = reason
+
+
+def _fleet(clk, pools=("prefill", "decode")):
+    """Router over stub replicas (one per pool tag) + a spawn_fn that
+    grows it with more stubs, counting spawns per pool."""
+    replicas = [LocalReplica(f"{p[0]}{i}", _StubFrontend(), pool=p)
+                for i, p in enumerate(pools)]
+    router = Router(replicas, hedge=False, health_every=0, clock=clk)
+    spawned = []
+
+    def spawn(pool):
+        name = f"{pool[0]}{len(router.replicas) + len(spawned)}x"
+        spawned.append(pool)
+        return router.add_replica(
+            LocalReplica(name, _StubFrontend(), pool=pool))
+
+    return router, spawn, spawned
+
+
+def test_floor_above_ceiling_rejected():
+    clk = _Clock()
+    router, spawn, _ = _fleet(clk)
+    try:
+        with pytest.raises(ValueError):
+            Autoscaler(router, spawn_fn=spawn, clock=clk,
+                       decode_min=5, decode_max=2)
+    finally:
+        router.close()
+
+
+def test_burn_breach_scales_up_each_pool():
+    clk = _Clock()
+    router, spawn, spawned = _fleet(clk)
+    burn = {"v": 0.0}
+    scaler = Autoscaler(router, spawn_fn=spawn, clock=clk,
+                        burn_fn=lambda: burn["v"], burn_threshold=1.0,
+                        cooldown_s=0.0)
+    try:
+        assert scaler.evaluate() == 0          # no pressure, no action
+        burn["v"] = 2.0                        # error budget burning NOW
+        assert scaler.evaluate() == 2          # +1 per pool, queue empty
+        assert spawned == ["prefill", "decode"]
+        assert len(router.pool_members("prefill")) == 2
+        assert len(router.pool_members("decode")) == 2
+        assert _gauge("autoscale/target/prefill") == 2
+        # the replicas gauge reads the pool at evaluation time — the
+        # next pass sees the spawned capacity live
+        burn["v"] = 0.0
+        assert scaler.evaluate() == 0
+        assert _gauge("autoscale/replicas/prefill") == 2
+    finally:
+        router.close()
+
+
+def test_queue_pressure_scales_to_the_knee_and_clamps():
+    clk = _Clock()
+    replicas = [LocalReplica("r0", _StubFrontend())]   # one "any" replica
+    router = Router(replicas, hedge=False, health_every=0, clock=clk)
+    spawned = []
+
+    def spawn(pool):
+        spawned.append(pool)
+        router.add_replica(LocalReplica(f"r{len(spawned)}",
+                                        _StubFrontend()))
+
+    scaler = Autoscaler(router, spawn_fn=spawn, clock=clk,
+                        queue_high=2.0, prefill_max=4, decode_max=4,
+                        cooldown_s=0.0)
+    try:
+        # mean load 10 against a knee of 2 wants ceil(10/2)=5 replicas —
+        # clamped at the ceiling of 4, so exactly 3 spawns
+        replicas[0].frontend._running = {i: None for i in range(10)}
+        assert scaler.evaluate() == 3
+        assert spawned == ["any"] * 3
+        assert _gauge("autoscale/target/any") == 4
+    finally:
+        router.close()
+
+
+def test_sustained_idle_scales_down_to_floor_never_below():
+    clk = _Clock()
+    replicas = [LocalReplica(f"r{i}", _StubFrontend()) for i in range(3)]
+    router = Router(replicas, hedge=False, health_every=0, clock=clk)
+    scaler = Autoscaler(router, spawn_fn=lambda p: None, clock=clk,
+                        idle_s=5.0, cooldown_s=0.0,
+                        prefill_min=1, decode_min=1)
+    d0 = _counter("autoscale/scale_downs")
+    try:
+        assert scaler.evaluate() == 0          # idle starts counting here
+        clk.t = 4.0
+        assert scaler.evaluate() == 0          # not sustained yet
+        clk.t = 5.0
+        assert scaler.evaluate() == -1         # one victim per action
+        assert router._draining == {"r0"}      # least loaded, name order
+        router.poll()                          # no streams → removed
+        assert {r.name for r in router.replicas} == {"r1", "r2"}
+        clk.t = 10.0
+        assert scaler.evaluate() == -1
+        router.poll()
+        assert {r.name for r in router.replicas} == {"r2"}
+        # at the floor: sustained idle no longer shrinks
+        clk.t = 100.0
+        assert scaler.evaluate() == 0
+        assert len(router.replicas) == 1
+        assert _counter("autoscale/scale_downs") - d0 == 2
+    finally:
+        router.close()
+
+
+def test_cooldown_guards_flapping():
+    clk = _Clock()
+    router, spawn, spawned = _fleet(clk, pools=("any",))
+    scaler = Autoscaler(router, spawn_fn=spawn, clock=clk,
+                        burn_fn=lambda: 2.0, cooldown_s=10.0)
+    try:
+        assert scaler.evaluate() == 1          # first breach acts
+        clk.t = 1.0
+        assert scaler.evaluate() == 0          # inside cooldown: frozen
+        clk.t = 9.9
+        assert scaler.evaluate() == 0
+        clk.t = 10.0
+        assert scaler.evaluate() == 1          # cooldown elapsed
+        assert spawned == ["any", "any"]
+    finally:
+        router.close()
+
+
+def test_maybe_evaluate_respects_cadence():
+    clk = _Clock()
+    router, spawn, _ = _fleet(clk, pools=("any",))
+    scaler = Autoscaler(router, spawn_fn=spawn, clock=clk,
+                        evaluate_every_s=1.0)
+    e0 = _counter("autoscale/evaluations")
+    try:
+        scaler.maybe_evaluate()
+        clk.t = 0.5
+        scaler.maybe_evaluate()                # off-cadence: skipped
+        clk.t = 1.0
+        scaler.maybe_evaluate()
+        assert _counter("autoscale/evaluations") - e0 == 2
+    finally:
+        router.close()
+
+
+def test_scale_down_sequences_drain_stream_completion_removal():
+    """The safety ordering: drain stops admissions while the in-flight
+    stream keeps running; the replica is only removed (KV released,
+    drain_fn fired) — never while a stream is still assigned."""
+    clk = _Clock()
+    replicas = [LocalReplica(f"r{i}", _StubFrontend()) for i in range(2)]
+    router = Router(replicas, hedge=False, health_every=0, clock=clk)
+    drained_cb = []
+
+    def drain_fn(name):
+        # sequencing: by the time the process owner hears about it, the
+        # router has already stopped admissions to the victim
+        assert name in router._draining
+        drained_cb.append(name)
+
+    scaler = Autoscaler(router, spawn_fn=lambda p: None,
+                        drain_fn=drain_fn, clock=clk, idle_s=1.0,
+                        cooldown_s=0.0, drain_deadline_s=60.0)
+    try:
+        req = router.submit([1, 2, 3], max_new_tokens=2)
+        victim = req.primary.replica
+        scaler._scale_down_victim = lambda pool, members: victim
+        scaler.evaluate()                      # idle clock starts (load
+        clk.t = 1.0                            # is frontend-side only)
+        assert scaler.evaluate() == -1
+        assert drained_cb == [victim.name]
+        router.poll()
+        # stream still assigned → replica must NOT be removed yet
+        assert victim.name in {r.name for r in router.replicas}
+        assert not req.done
+        inner = victim.frontend.submitted[0]
+        inner.tokens_out.extend([7, 8])
+        _finish(inner)
+        router.poll()
+        assert req.done and req.finish_reason == "length"
+        assert req.tokens_out == [7, 8]        # finished on the victim
+        assert victim.name not in {r.name for r in router.replicas}
+        assert victim.name not in router._draining
+    finally:
+        router.close()
+
+
+def test_replica_killed_mid_scale_down_converges(monkeypatch):
+    """The scale-down chaos drill: the draining victim is killed while
+    its stream is still in flight. The stream fails over with the token
+    fold, the fleet converges (victim gone, nothing pending), and
+    faults == recoveries still closes."""
+    clk = _Clock()
+    replicas = [LocalReplica(f"r{i}", _StubFrontend()) for i in range(2)]
+    router = Router(replicas, hedge=False, health_every=0, clock=clk)
+    scaler = Autoscaler(router, spawn_fn=lambda p: None, clock=clk,
+                        idle_s=1.0, cooldown_s=0.0, drain_deadline_s=60.0)
+    f0 = _counter("resilience/faults_injected")
+    r0 = _counter("resilience/recoveries")
+    try:
+        req = router.submit([1, 2, 3], max_new_tokens=3)
+        victim = req.primary.replica
+        survivor = next(r for r in replicas if r is not victim)
+        scaler._scale_down_victim = lambda pool, members: victim
+        inner1 = victim.frontend.submitted[0]
+        inner1.tokens_out.append(9)
+        router.poll()                          # one token delivered
+        scaler.evaluate()
+        clk.t = 1.0
+        assert scaler.evaluate() == -1
+        assert victim.name in router._draining
+        # chaos: kill the named victim in the mid-scale-down window
+        monkeypatch.setenv("DSTPU_CHAOS_REPLICA", victim.name)
+        fault_injector.arm(
+            f"serving_step:{router._polls + 1}:replica_kill:router",
+            _env=False)
+        router.poll()
+        assert not victim.alive
+        inner2 = survivor.frontend.submitted[-1]
+        assert inner2.prompt == [1, 2, 3, 9]   # fold replay: gapless
+        inner2.tokens_out.extend([10, 11])
+        _finish(inner2)
+        router.poll()
+        router.poll()
+        assert req.done and req.finish_reason == "length"
+        assert req.tokens_out == [9, 10, 11]
+        # converged: victim out of the fleet, no drain or recovery open
+        assert victim.name not in {r.name for r in router.replicas}
+        assert not router._draining and not router._pending_recovery
+        assert _counter("resilience/faults_injected") - f0 == 1
+        assert _counter("resilience/recoveries") - r0 == 1
+    finally:
+        fault_injector.disarm()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: autoscale gauges in dstpu-top, draining in the doctor
+# ---------------------------------------------------------------------------
+
+def test_fleet_table_renders_autoscale_targets():
+    from deepspeed_tpu.telemetry.fleet import autoscale_targets
+    m = {"autoscale_target_prefill": 2.0, "autoscale_replicas_prefill": 1.0,
+         "autoscale_target_decode": 4.0, "autoscale_replicas_decode": 4.0}
+    assert autoscale_targets(m) == {
+        "prefill": {"target": 2, "live": 1},
+        "decode": {"target": 4, "live": 4}}
+    assert autoscale_targets({"serving_ttft_seconds": 1.0}) is None
+
+
+def test_doctor_reports_draining_as_intentional():
+    from deepspeed_tpu.telemetry.doctor import analyze, render
+    report = analyze([], [{"hostname": "h0", "phase": "draining",
+                           "replica": "d1", "agent": True}])
+    assert report["draining"] == [{"host": "h0", "replica": "d1"}]
+    assert report["crash_looping"] == []
+    text = render(report)
+    assert "draining: h0 replica=d1" in text
+    assert "not a crash loop" in text
+
+
+# ---------------------------------------------------------------------------
+# ReplicaPoolAgent: drain-before-SIGTERM, draining heartbeats, scale-up
+# ---------------------------------------------------------------------------
+
+_SLEEP_CMD = ["python", "-c", "import time; time.sleep(60)"]
+
+
+def _hb(tmp_path, name):
+    with open(os.path.join(str(tmp_path), f"{name}.json")) as fh:
+        return json.load(fh)
+
+
+def test_agent_drain_phases_heartbeats_and_add_replica(tmp_path):
+    from deepspeed_tpu.launcher.agent import ReplicaPoolAgent
+    pool = ReplicaPoolAgent(_SLEEP_CMD, 2,
+                            heartbeat_dir=str(tmp_path)).start()
+    try:
+        assert set(pool.poll().values()) == {"running"}
+        # scale-up: names never recycle
+        assert pool.add_replica() == "r2"
+        assert pool.poll()["r2"] == "running"
+        # graceful scale-down: draining, NOT crash_loop, no restart
+        pool.begin_drain("r0")
+        phases = pool.poll()
+        assert phases["r0"] == "draining"
+        hb = _hb(tmp_path, "r0")
+        assert hb["phase"] == "draining" and hb["replica"] == "r0"
+        assert hb["agent"] is True
+        # the process is still alive — SIGTERM only lands after the
+        # router has drained the streams
+        assert pool._children["r0"].poll() is None
+        pool.finish_drain("r0", grace_s=2.0)
+        assert pool._children["r0"].poll() is not None
+        assert pool.poll()["r0"] == "down"
+        assert _hb(tmp_path, "r0")["drained"] is True
+        with pytest.raises(KeyError):
+            pool.finish_drain("r0")            # not draining anymore
+        with pytest.raises(KeyError):
+            pool.begin_drain("nope")
+    finally:
+        pool.stop(grace_s=2.0)
+
+
+def test_agent_replica_dying_mid_drain_goes_down_not_restarted(tmp_path):
+    from deepspeed_tpu.launcher.agent import ReplicaPoolAgent
+    pool = ReplicaPoolAgent(_SLEEP_CMD, 2, max_restarts=2,
+                            heartbeat_dir=str(tmp_path)).start()
+    try:
+        pool.begin_drain("r1")
+        assert pool.poll()["r1"] == "draining"
+        # chaos kills it in the scale-down window: it was leaving on
+        # purpose, so it goes DOWN — never restarting, never crash_loop
+        os.killpg(os.getpgid(pool._children["r1"].pid), signal.SIGKILL)
+        pool._children["r1"].wait()
+        phases = pool.poll()
+        assert phases["r1"] == "down"
+        assert phases["r0"] == "running"
+        assert pool.restarts == 0
+        assert pool.poll()["r1"] == "down"     # stays down
+    finally:
+        pool.stop(grace_s=2.0)
+
+
+def test_agent_stop_drains_before_sigterm(tmp_path):
+    from deepspeed_tpu.launcher.agent import ReplicaPoolAgent
+    pool = ReplicaPoolAgent(_SLEEP_CMD, 2,
+                            heartbeat_dir=str(tmp_path)).start()
+    order = []
+
+    def drain(name):
+        # drain callback runs while the replica process is still alive
+        assert pool._children[name].poll() is None
+        assert _hb(tmp_path, name)["phase"] == "draining"
+        order.append(name)
+
+    pool.stop(grace_s=2.0, drain=drain)
+    assert order == ["r0", "r1"]
+    assert all(p == "down" for p in pool.poll().values())
+    assert all(_hb(tmp_path, n)["phase"] == "down" for n in ("r0", "r1"))

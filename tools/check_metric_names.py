@@ -18,6 +18,12 @@ Only literal string / f-string first arguments are checked; call sites
 passing a variable (e.g. ``gauge(name)`` in a generic flusher) are
 skipped — their names are produced by checked call sites upstream.
 
+The tool also lints the FAULT CATALOG: every injectable fault kind
+declared in ``resilience/faults.py`` (the module-level ``*_KINDS``
+tuples the FaultInjector validates plans against) must be documented in
+``docs/resilience.md`` — an undocumented kind is a chaos drill nobody
+can discover or interpret from the runbook.
+
 Usage: ``python tools/check_metric_names.py [root]`` → exit 0 clean,
 exit 1 with one line per violation. Invoked from the tier-1 suite
 (tests/test_diagnostics.py) so a bad name fails CI.
@@ -34,9 +40,9 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
-KNOWN_AREAS = ("anomaly", "comm", "compile", "dispatch", "fleet", "mem",
-               "overlap", "resilience", "roofline", "router", "serving",
-               "slo", "train")
+KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
+               "fleet", "handoff", "mem", "overlap", "resilience",
+               "roofline", "router", "serving", "slo", "train")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
@@ -115,6 +121,47 @@ def check(sites) -> List[str]:
     return errors
 
 
+def collect_fault_kinds(pkg_root: str) -> List[str]:
+    """Every fault kind declared in resilience/faults.py: the string
+    elements of module-level ``*_KINDS`` tuple assignments (the same
+    tuples the FaultInjector validates plan entries against)."""
+    path = os.path.join(pkg_root, "resilience", "faults.py")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    kinds: List[str] = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_KINDS")):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                kinds.append(sub.value)
+    # ADVISORY_KINDS concatenates the other tuples — dedup, keep order
+    return list(dict.fromkeys(kinds))
+
+
+def check_fault_kinds(pkg_root: str) -> List[str]:
+    """Every declared fault kind must appear in docs/resilience.md."""
+    kinds = collect_fault_kinds(pkg_root)
+    if not kinds:
+        return []
+    doc_path = os.path.join(os.path.dirname(pkg_root), "docs",
+                            "resilience.md")
+    if not os.path.exists(doc_path):
+        return [f"docs/resilience.md missing but resilience/faults.py "
+                f"declares {len(kinds)} fault kinds"]
+    with open(doc_path, encoding="utf-8") as fh:
+        doc = fh.read()
+    return [f"resilience/faults.py declares fault kind {k!r} but "
+            f"docs/resilience.md never mentions it (document the drill "
+            f"in the fault catalog)"
+            for k in kinds if k not in doc]
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
@@ -122,10 +169,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "deepspeed_tpu")
     sites = collect_sites(root)
     errors = check(sites)
+    errors += check_fault_kinds(root)
     for e in errors:
         print(e)
     if not errors:
-        print(f"check_metric_names: {len(sites)} literal call sites OK")
+        print(f"check_metric_names: {len(sites)} literal call sites OK; "
+              f"{len(collect_fault_kinds(root))} fault kinds documented")
     return 1 if errors else 0
 
 
