@@ -116,6 +116,114 @@ def _suite(root):
     return suite
 
 
+def from_config_main(args) -> None:
+    """``--from-config best.json``: replay a ``dstpu-tune`` winner and
+    stamp predicted-vs-measured into ``extra.tune``. The emitted config
+    carries everything needed — the mesh rebuilds from its
+    parallel-topology knobs (``mesh_from_config``), the training knobs
+    pass straight to ``initialize``, and the ``tune`` stamp supplies the
+    model preset / sequence length / roofline prediction. When the tuned
+    chip count exceeds the local devices, the run falls back to pure-DP
+    over what exists (TP/SP/EP coerced away) — a scaled-down sanity run,
+    flagged ``scaled_down`` in the stamp, not the tuned point."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import mesh_from_config
+
+    with open(args.from_config) as fh:
+        cfg = json.load(fh)
+    parsed = DeepSpeedTPUConfig.from_any(dict(cfg))
+    stamp = parsed.tune
+    dev0 = jax.devices()[0]
+    n_dev = len(jax.devices())
+    on_tpu = dev0.platform == "tpu"
+
+    size = args.size or str(stamp.model or "llama3-tiny").split(
+        "llama3-")[-1]
+    seq = args.seq or int(stamp.seq_len or (2048 if on_tpu else 128))
+    steps = args.steps or (24 if on_tpu else 3)
+    warmup = 3 if on_tpu else 1
+    model = llama3_config(size, max_seq_len=seq, tie_embeddings=True)
+
+    chips = 1
+    for v in (stamp.mesh or {}).values():
+        chips *= int(v)
+    train_cfg = {k: v for k, v in cfg.items()
+                 if k not in ("tune", "serving", "router", "autoscale")}
+    _apply_bench_slo(train_cfg)
+    scaled_down = chips > n_dev
+    if scaled_down:
+        for k in ("tensor_parallel", "sequence_parallel", "moe"):
+            train_cfg.pop(k, None)
+        ds.build_mesh(data=n_dev)
+        run_chips = n_dev
+    else:
+        run_chips = max(1, chips)
+        mesh_from_config(parsed, devices=jax.devices()[:run_chips])
+    engine, *_ = ds.initialize(model=model, config=train_cfg,
+                               rng=jax.random.PRNGKey(0))
+
+    gb = int(engine.config.train_batch_size)
+    rng = np.random.default_rng(0)
+    batches = [jax.device_put({"input_ids": rng.integers(
+        0, model.vocab_size, size=(gb, seq), dtype=np.int32)})
+        for _ in range(4)]
+    for i in range(warmup):
+        float(engine.train_batch(iter([batches[i % 4]])))
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(steps):
+        loss = engine.train_batch(iter([batches[i % 4]]))
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+    measured_ms = dt / steps * 1e3
+
+    tokens = gb * seq * steps
+    tune_extra = {
+        "config": os.path.basename(args.from_config),
+        "search_key": stamp.search_key,
+        "tuned_platform": stamp.platform,
+        "tuned_chips": stamp.chips,
+        "run_chips": run_chips,
+        "scaled_down": scaled_down,
+        "predicted_ms": stamp.predicted_step_ms,
+        "measured_ms": round(measured_ms, 3),
+        "pct_of_roofline": None,
+    }
+    try:
+        from deepspeed_tpu.telemetry import explain as _explain
+        rep = _explain.explain_engine(engine, measured_step_ms=measured_ms)
+        rl = rep.roofline
+        tune_extra["local_predicted_ms"] = round(rl.predicted_s * 1e3, 3)
+        tune_extra["bound"] = rl.bound
+        tune_extra["pct_of_roofline"] = round(
+            rl.pct_of(dt / steps) or 0.0, 2)
+    except Exception:
+        pass
+    result = {
+        "metric": f"tokens/sec/chip tuned llama3-{size} seq{seq} "
+                  f"[{stamp.search_key or 'untuned config'}]",
+        "value": round(tokens / dt / run_chips, 2),
+        "unit": "tokens/s/chip",
+        "extra": {
+            "loss": loss_val,
+            "platform": dev0.platform,
+            "n_devices": n_dev,
+            "steps": steps,
+            "global_batch": gb,
+            "tune": tune_extra,
+            "slo": _slo_extra(engine),
+        },
+    }
+    print(json.dumps(result))
+    if getattr(args, "trace", None):
+        from deepspeed_tpu.telemetry import tracer
+        tracer.dump(args.trace)
+
+
 def moe_main(args) -> None:
     """MoE training bench: ~1B total params, 8 experts, top-2, dropless
     (lax.ragged_dot) dispatch — MFU on ACTIVE params (the standard MoE
@@ -453,11 +561,19 @@ def main() -> None:
                     help="run a short training loop under a scripted "
                          "fault plan (dstpu-chaos) and report the "
                          "recovery ledger instead of MFU")
+    ap.add_argument("--from-config", default=None, metavar="JSON",
+                    help="replay a dstpu-tune winner: build the mesh and "
+                         "engine from the emitted config and stamp "
+                         "predicted-vs-measured step time into "
+                         "extra.tune")
     args = ap.parse_args()
 
     if args.trace:
         from deepspeed_tpu.telemetry import tracer
         tracer.configure(enabled=True)
+    if args.from_config:
+        from_config_main(args)
+        return
     if args.chaos:
         chaos_main(args)
         return

@@ -327,11 +327,61 @@ def bench_diurnal(args) -> None:
                "max_batch_tokens": 256, "max_sequences": 16,
                "use_pallas": (False if args.no_pallas else None)}
 
+    # --from-config: a dstpu-tune plan drives the fleet knobs — engine
+    # SplitFuse budget / prefill chunk / resident sequences from the
+    # tune stamp's serving_engine keys, hedge policy from router.*,
+    # floors/ceilings/queue knee from autoscale.* (ceilings clamped to
+    # this host's drill scale; the scenario's fast timing knobs stay so
+    # the drill still converges in CI time)
+    tuned = getattr(args, "_tuned_cfg", None)
+    tuned_stamp = None
+    scaler_kw = {"prefill_min": 1, "prefill_max": 3,
+                 "decode_min": 1, "decode_max": 4, "queue_high": 2.0}
+    hedge_kw = {"hedge": False}
+    serving_kw = {}
+    if tuned:
+        tuned_stamp = dict(tuned.get("tune") or {})
+        se = dict(tuned_stamp.get("serving_engine") or {})
+        if se.get("prefill_chunk"):
+            eng_cfg["prefill_chunk"] = max(8, min(64, int(
+                se["prefill_chunk"])))
+        if se.get("max_batch_tokens"):
+            eng_cfg["max_batch_tokens"] = max(64, min(1024, int(
+                se["max_batch_tokens"])))
+        if se.get("max_sequences"):
+            eng_cfg["max_sequences"] = max(4, min(16, int(
+                se["max_sequences"])))
+        rb = dict(tuned.get("router") or {})
+        if rb:
+            hedge_kw = {"hedge": bool(rb.get("hedge", False)),
+                        "hedge_delay_s": rb.get("hedge_delay_s")}
+        ab = dict(tuned.get("autoscale") or {})
+        if ab:
+            scaler_kw = {
+                "prefill_min": max(1, min(int(ab.get("prefill_min", 1)),
+                                          3)),
+                "prefill_max": max(1, min(int(ab.get("prefill_max", 3)),
+                                          3)),
+                "decode_min": max(1, min(int(ab.get("decode_min", 1)), 4)),
+                "decode_max": max(1, min(int(ab.get("decode_max", 4)), 4)),
+                "queue_high": max(1.0, float(ab.get("queue_high", 2.0))),
+            }
+            scaler_kw["prefill_min"] = min(scaler_kw["prefill_min"],
+                                           scaler_kw["prefill_max"])
+            scaler_kw["decode_min"] = min(scaler_kw["decode_min"],
+                                          scaler_kw["decode_max"])
+        sb = dict(tuned.get("serving") or {})
+        if sb.get("megastep_tokens"):
+            serving_kw = {"megastep_tokens": int(sb["megastep_tokens"])}
+
+    frontends = []
+
     def make_replica(pool: str, name: str) -> LocalReplica:
         eng = RaggedInferenceEngineTPU(model, dict(eng_cfg),
                                        params=params)
-        return LocalReplica(name, ServingFrontend(eng, max_queue=256),
-                            pool=pool)
+        fe = ServingFrontend(eng, max_queue=256, **serving_kw)
+        frontends.append(fe)
+        return LocalReplica(name, fe, pool=pool)
 
     spawned = {"prefill": 0, "decode": 0}
 
@@ -341,11 +391,9 @@ def bench_diurnal(args) -> None:
             make_replica(pool, f"{pool[0]}{spawned[pool]}"))
 
     router = Router([make_replica("prefill", "p0"),
-                     make_replica("decode", "d0")], hedge=False)
+                     make_replica("decode", "d0")], **hedge_kw)
     scaler = Autoscaler(router, spawn_fn=spawn,
-                        prefill_min=1, prefill_max=3,
-                        decode_min=1, decode_max=4,
-                        queue_high=2.0, idle_s=0.3, cooldown_s=0.2,
+                        **scaler_kw, idle_s=0.3, cooldown_s=0.2,
                         evaluate_every_s=0.05, drain_deadline_s=15.0)
 
     rng = np.random.default_rng(0)
@@ -402,6 +450,7 @@ def bench_diurnal(args) -> None:
     # final trough spins long enough for idle scale-down + the kill)
     phases = [("night", 2, 0.0), ("morning", 6, 0.0),
               ("peak", 20, 0.0), ("evening", 2, 1.2)]
+    steps0 = sum(fe.metrics.counters["engine_steps"] for fe in frontends)
     t0 = time.perf_counter()
     all_reqs = []
     phase_rows = []
@@ -433,6 +482,39 @@ def bench_diurnal(args) -> None:
     recoveries = int(c("resilience/recoveries").value -
                      base["resilience/recoveries"])
     peak_pools = max(sum(row["pools"].values()) for row in phase_rows)
+    tune_extra = None
+    if tuned_stamp is not None:
+        # predicted-vs-measured per engine step: the cost model's decode
+        # prediction against the drill's mean wall time per engine step
+        # (mixed prefill/decode; CPU hosts predict 0 → pct stays None)
+        eng_steps = sum(fe.metrics.counters["engine_steps"]
+                        for fe in frontends) - steps0
+        measured_ms = wall / eng_steps * 1e3 if eng_steps else None
+        predicted_ms = None
+        try:
+            recs = frontends[0].engine.cost_records()
+            p = recs.get("decode", {}).get("predicted_s")
+            predicted_ms = p * 1e3 if p else None
+        except Exception:
+            pass
+        tune_extra = {
+            "config": tuned_stamp.get("_path"),
+            "search_key": tuned_stamp.get("search_key"),
+            "tuned_platform": tuned_stamp.get("platform"),
+            "predicted_ms": predicted_ms,
+            "measured_ms": (round(measured_ms, 3)
+                            if measured_ms else None),
+            "pct_of_roofline": (round(100.0 * predicted_ms / measured_ms,
+                                      2)
+                                if predicted_ms and measured_ms
+                                else None),
+            "applied": {"engine": {k: eng_cfg[k] for k in
+                                   ("prefill_chunk", "max_batch_tokens",
+                                    "max_sequences")},
+                        "router": hedge_kw,
+                        "autoscale": scaler_kw,
+                        "serving": serving_kw},
+        }
     result = {
         "metric": f"diurnal elasticity llama3-{size}: disagg "
                   f"prefill/decode fleet, "
@@ -466,6 +548,8 @@ def bench_diurnal(args) -> None:
             "slo": _slo_extra(),
         },
     }
+    if tune_extra is not None:
+        result["extra"]["tune"] = tune_extra
     router.close()
     print(json.dumps(result))
 
@@ -508,6 +592,12 @@ def main() -> None:
                          "(default 0.05 for deterministic A/Bs)")
     ap.add_argument("--no-hedge", action="store_true",
                     help="router scenario: disable hedged dispatch")
+    ap.add_argument("--from-config", default=None, metavar="JSON",
+                    help="drive the diurnal fleet scenario from a "
+                         "dstpu-tune emitted config: serving/router/"
+                         "autoscale blocks size the drill's knobs and "
+                         "extra.tune stamps predicted-vs-measured "
+                         "(forces --scenario diurnal)")
     ap.add_argument("--megastep", nargs="?", const=32, type=int,
                     default=None, metavar="K",
                     help="A/B the serving frontend stepwise vs decode "
@@ -516,6 +606,14 @@ def main() -> None:
                          "tok/s and host-dispatch calls per token "
                          "(dispatch/host_calls deltas) into the JSON")
     args = ap.parse_args()
+
+    if args.from_config:
+        with open(args.from_config) as fh:
+            cfg = json.load(fh)
+        cfg.setdefault("tune", {})["_path"] = os.path.basename(
+            args.from_config)
+        args._tuned_cfg = cfg
+        args.scenario = "diurnal"
 
     if args.scenario == "shared_prefix_stream":
         return bench_shared_prefix(args)

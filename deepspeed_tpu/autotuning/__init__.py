@@ -1,5 +1,30 @@
-"""Autotuning (reference: deepspeed/autotuning/ — 2,722 LoC Autotuner)."""
+"""Autotuning (reference: deepspeed/autotuning/ — 2,722 LoC Autotuner).
+
+Two tiers:
+
+- :class:`Autotuner` (seed) — *measured* sweep: builds engines on the
+  local devices and ranks by throughput;
+- :mod:`.search` / :mod:`.tune` / :mod:`.serving_plan` (``dstpu-tune``)
+  — *offline* sweep: enumerates mesh/ZeRO/overlap/remat/micro-batch
+  candidates, prunes by the HBM table, scores with the explain.py
+  roofline, and emits ready-to-run config JSON plus a serving fleet
+  plan. Nothing is allocated; 256-chip configs size from a laptop.
+"""
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner, TuneResult
+from deepspeed_tpu.autotuning.search import (Candidate, SearchSpace,
+                                             candidate_hbm,
+                                             enumerate_candidates,
+                                             mesh_factorizations,
+                                             predict_candidate,
+                                             prune_infeasible)
+from deepspeed_tpu.autotuning.serving_plan import (TrafficMix, plan_serving,
+                                                   predict_serving_records)
+from deepspeed_tpu.autotuning.tune import (ScoredCandidate, TuneReport,
+                                           emit_config, run_tune)
 
-__all__ = ["Autotuner", "TuneResult"]
+__all__ = ["Autotuner", "TuneResult", "Candidate", "SearchSpace",
+           "candidate_hbm", "enumerate_candidates", "mesh_factorizations",
+           "predict_candidate", "prune_infeasible", "TrafficMix",
+           "plan_serving", "predict_serving_records", "ScoredCandidate",
+           "TuneReport", "emit_config", "run_tune"]

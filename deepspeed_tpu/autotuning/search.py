@@ -1,0 +1,398 @@
+"""Offline search space for ``dstpu-tune``: candidate enumeration,
+HBM-feasibility pruning, and an analytic roofline prediction.
+
+The seed :class:`~deepspeed_tpu.autotuning.autotuner.Autotuner` measures
+candidates by building engines and timing steps — right on the target
+chips, useless for sizing a 256-chip job from a laptop. This module is
+the offline half (ROADMAP item 1): every candidate is scored without
+building anything, by feeding closed-form FLOPs / HBM-traffic /
+collective-bytes counts into the same :class:`telemetry.explain.Roofline`
+model (predicted step = max(compute, memory, comm)) that ``explain.py``
+derives from real lowered programs — so the analytic score and the
+lowered score share units, peaks tables, and the bound taxonomy.
+
+Candidates that fit on the local host (e.g. the 8-virtual-device CPU
+mesh) can additionally be *lowered* for exact XLA numbers
+(``tune.py --lower``); the analytic tier is what makes
+``--chips 256 --platform v5e`` work from anywhere.
+
+Mesh-shape constraints (``mesh_factorizations``):
+- ``model`` (tensor parallel) must divide both ``num_heads`` and
+  ``kv_heads`` (row/col sharding of attention projections);
+- ``seq`` (Ulysses) must divide ``num_heads`` (the all-to-all
+  repartitions heads ↔ sequence) and the sequence length;
+- ``expert`` must divide ``num_experts`` (absent for dense models);
+- the remaining factor is ``data`` (the ZeRO axis) and must be ≥ 1.
+"""
+
+import itertools
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.autotuning.autotuner import estimate_candidate_hbm
+from deepspeed_tpu.telemetry.explain import Peaks, Roofline
+from deepspeed_tpu.utils.logging import logger
+
+#: fraction of the forward pass recomputed in backward, by remat policy
+#: (the compute side of the remat ↔ activation-memory trade the tuner
+#: searches; the memory side lives in estimate_candidate_hbm's
+#: per_layer_d table)
+REMAT_RECOMPUTE: Dict[str, float] = {
+    "none": 0.0,
+    "save_attn_out": 0.55,
+    "save_attn_kernel": 0.55,
+    "dots_saveable": 0.35,
+    "full": 1.0,
+    "offload_full": 0.15,          # D2H/H2D traffic, little recompute
+    "nothing_saveable": 1.0,
+}
+
+
+class _MeshShim:
+    """Duck-typed stand-in for ``jax.sharding.Mesh`` exposing only
+    ``.shape`` — enough for :func:`estimate_candidate_hbm`, with no jax
+    devices required (the whole point: prune a 256-chip candidate from a
+    laptop before anything exists)."""
+
+    def __init__(self, shape: Dict[str, int]):
+        self.shape = dict(shape)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the search space. Frozen + fully ordered through
+    :meth:`key` so enumeration and ranking are deterministic."""
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    zero_stage: int = 3
+    micro_batch: int = 1
+    grad_accum: int = 1
+    remat: str = "none"
+    #: PR 6 chunked-overlap knobs (stage 3 only; ignored below)
+    overlap: bool = True
+    overlap_prefetch: int = 1
+    overlap_regather: bool = True
+    overlap_bucket_bytes: int = 0
+    #: compute dtype: bf16 (the TPU default) vs fp32
+    bf16: bool = True
+    #: chunked-CE logits budget (None → engine default)
+    ce_budget_mb: Optional[int] = None
+
+    @property
+    def chips(self) -> int:
+        return self.data * self.model * self.seq * self.expert
+
+    def mesh_dict(self) -> Dict[str, int]:
+        return {"pipe": 1, "data": self.data, "data_inner": 1,
+                "expert": self.expert, "seq": self.seq,
+                "model": self.model}
+
+    def key(self) -> str:
+        """Deterministic identity — the ranking tie-break, the cost-cache
+        key, and the ``tune.search_key`` stamp in emitted configs."""
+        ov = (f"ov{int(self.overlap)}p{self.overlap_prefetch}"
+              f"rg{int(self.overlap_regather)}b{self.overlap_bucket_bytes}"
+              if self.zero_stage >= 3 else "ov-")
+        ce = f".ce{self.ce_budget_mb}" if self.ce_budget_mb else ""
+        return (f"d{self.data}.m{self.model}.s{self.seq}.e{self.expert}"
+                f".z{self.zero_stage}.mb{self.micro_batch}"
+                f".ga{self.grad_accum}.r-{self.remat}.{ov}"
+                f".{'bf16' if self.bf16 else 'fp32'}{ce}")
+
+    def to_config(self, base: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+        """Ready-to-run DeepSpeedTPUConfig dict: the mesh shape is
+        encoded through the parallel-topology blocks (so
+        ``mesh_from_config`` rebuilds it) and every searched knob lands
+        on its real config key — the emitted JSON reproduces the scored
+        candidate when fed straight back to ``initialize()``."""
+        import copy
+        cfg: Dict[str, Any] = copy.deepcopy(base) if base else {}
+        cfg["train_micro_batch_size_per_gpu"] = self.micro_batch
+        cfg["gradient_accumulation_steps"] = self.grad_accum
+        cfg.pop("train_batch_size", None)
+        zo = cfg.setdefault("zero_optimization", {})
+        zo["stage"] = self.zero_stage
+        if self.zero_stage >= 3:
+            zo["overlap_comm"] = self.overlap
+            if self.overlap:
+                zo["overlap_prefetch"] = self.overlap_prefetch
+                zo["overlap_regather"] = self.overlap_regather
+                if self.overlap_bucket_bytes:
+                    zo["overlap_bucket_bytes"] = self.overlap_bucket_bytes
+        cfg.setdefault("activation_checkpointing", {})["policy"] = \
+            self.remat
+        cfg.setdefault("bf16", {})["enabled"] = self.bf16
+        if self.ce_budget_mb:
+            cfg["chunked_ce_budget_mb"] = self.ce_budget_mb
+        if self.model > 1:
+            cfg.setdefault("tensor_parallel", {})["tp_size"] = self.model
+        if self.seq > 1:
+            cfg.setdefault("sequence_parallel", {})["size"] = self.seq
+        if self.expert > 1:
+            moe = cfg.setdefault("moe", {})
+            moe["enabled"] = True
+            moe["ep_size"] = self.expert
+        return cfg
+
+
+@dataclass
+class SearchSpace:
+    """Which axes ``enumerate_candidates`` sweeps. Defaults cover the
+    knobs that proved decisive on the v5e bench (ZeRO stage, micro-batch,
+    remat, overlap) without blowing the candidate count up."""
+    zero_stages: Sequence[int] = (1, 2, 3)
+    micro_batches: Sequence[int] = (1, 2, 4, 8)
+    remat_policies: Sequence[str] = ("none", "save_attn_out", "full")
+    #: (overlap, prefetch, regather) triples swept at stage 3; stage < 3
+    #: candidates always carry the monolithic default
+    overlap_variants: Sequence[Tuple[bool, int, bool]] = (
+        (False, 1, True), (True, 1, True), (True, 2, False))
+    grad_accums: Sequence[int] = (1,)
+    dtypes: Sequence[bool] = (True,)           # bf16 only by default
+    ce_budgets_mb: Sequence[Optional[int]] = (None,)
+    max_model: int = 16
+    max_seq_parallel: int = 8
+    #: enumeration guard — a sweep this size is a config error, not a run
+    max_candidates: int = 200_000
+
+
+def _divisors(n: int) -> List[int]:
+    out = [d for d in range(1, n + 1) if n % d == 0]
+    return out
+
+
+def mesh_factorizations(chips: int, dec_cfg,
+                        space: Optional[SearchSpace] = None
+                        ) -> List[Tuple[int, int, int, int]]:
+    """All (data, model, seq, expert) factorizations of ``chips`` that
+    the model's shape admits, sorted deterministically (dp-major first)."""
+    space = space or SearchSpace()
+    heads = dec_cfg.num_heads
+    kv = dec_cfg.kv_heads
+    seq_len = dec_cfg.max_seq_len
+    n_exp = getattr(dec_cfg, "num_experts", 0) or 0
+    models = [m for m in _divisors(chips)
+              if m <= space.max_model and heads % m == 0 and kv % m == 0]
+    seqs = [s for s in _divisors(chips)
+            if s <= space.max_seq_parallel and heads % s == 0
+            and seq_len % s == 0]
+    experts = [e for e in _divisors(chips) if n_exp and n_exp % e == 0] \
+        or [1]
+    shapes = set()
+    for m, s, e in itertools.product(models, seqs, experts):
+        denom = m * s * e
+        if chips % denom:
+            continue
+        d = chips // denom
+        if d >= 1:
+            shapes.add((d, m, s, e))
+    return sorted(shapes, key=lambda t: (-t[0], t[1], t[2], t[3]))
+
+
+def enumerate_candidates(dec_cfg, chips: int,
+                         space: Optional[SearchSpace] = None
+                         ) -> List[Candidate]:
+    """The full candidate list, deterministic order (sorted by key)."""
+    space = space or SearchSpace()
+    cands: List[Candidate] = []
+    for (d, m, s, e) in mesh_factorizations(chips, dec_cfg, space):
+        for stage in space.zero_stages:
+            variants = space.overlap_variants if stage >= 3 \
+                else [(False, 1, True)]
+            for mb, ga, remat, (ov, pf, rg), bf16, ce in \
+                    itertools.product(space.micro_batches,
+                                      space.grad_accums,
+                                      space.remat_policies,
+                                      variants, space.dtypes,
+                                      space.ce_budgets_mb):
+                cands.append(Candidate(
+                    data=d, model=m, seq=s, expert=e, zero_stage=stage,
+                    micro_batch=mb, grad_accum=ga, remat=remat,
+                    overlap=ov, overlap_prefetch=pf, overlap_regather=rg,
+                    bf16=bf16, ce_budget_mb=ce))
+                if len(cands) > space.max_candidates:
+                    raise ValueError(
+                        f"search space exceeds max_candidates="
+                        f"{space.max_candidates} — narrow the sweep axes")
+    cands.sort(key=lambda c: c.key())
+    return cands
+
+
+# ---------------------------------------------------------------------------
+# HBM feasibility (pruning)
+# ---------------------------------------------------------------------------
+
+def candidate_hbm(dec_cfg, cand: Candidate,
+                  seq_len: Optional[int] = None) -> Dict[str, float]:
+    """Per-device HBM prediction for one candidate — the seed
+    :func:`estimate_candidate_hbm` model (which understands ZeRO/MiCS
+    sharding over the data axes), extended with the axes the offline
+    search adds on top:
+
+    - tensor parallel shards params/grads/opt over ``model``;
+    - sequence parallel shards activations over ``seq``;
+    - the chunked-overlap path adds its transient gathered-chunk
+      footprint (prefetch+1 chunks; the whole gathered stack when
+      ``overlap_regather=False`` keeps forward chunks for backward).
+    """
+    cfg = cand.to_config()
+    est = estimate_candidate_hbm(dec_cfg, cfg, _MeshShim(cand.mesh_dict()),
+                                 seq_len=seq_len)
+    tp, sp = cand.model, cand.seq
+    out = {"params": est["params"] / tp, "grads": est["grads"] / tp,
+           "opt": est["opt"] / tp, "activations": est["activations"] / sp,
+           "ce": est["ce"] / max(tp, 1)}
+    p_bytes = 2 if cand.bf16 else 4
+    n_local = dec_cfg.num_params() * p_bytes / tp
+    if cand.zero_stage >= 3 and cand.overlap and cand.data > 1:
+        chunk = max(cand.overlap_bucket_bytes / max(tp, 1),
+                    n_local / max(dec_cfg.num_layers, 1))
+        if cand.overlap_regather:
+            out["overlap_transient"] = (cand.overlap_prefetch + 1) * chunk
+        else:
+            # forward-gathered chunks live through backward
+            out["overlap_transient"] = n_local
+    out["total"] = sum(out.values()) * 1.15     # same fudge as the seed
+    return out
+
+
+def prune_infeasible(dec_cfg, cands: Sequence[Candidate],
+                     capacity_bytes: float,
+                     seq_len: Optional[int] = None
+                     ) -> Tuple[List[Candidate],
+                                List[Tuple[Candidate, str]]]:
+    """Split candidates into (feasible, [(candidate, reason), ...]) by
+    the compile-free HBM table. ``capacity_bytes <= 0`` (unknown chip)
+    disables pruning — everything passes, with a one-time note."""
+    if capacity_bytes <= 0:
+        logger.warning("autotune: no HBM capacity for the target platform"
+                       " — feasibility pruning disabled")
+        return list(cands), []
+    keep: List[Candidate] = []
+    pruned: List[Tuple[Candidate, str]] = []
+    for c in cands:
+        est = candidate_hbm(dec_cfg, c, seq_len=seq_len)
+        if est["total"] <= capacity_bytes:
+            keep.append(c)
+        else:
+            pruned.append((c, f"predicted HBM "
+                              f"{est['total'] / 2**30:.2f} GiB > "
+                              f"{capacity_bytes / 2**30:.2f} GiB"))
+    return keep, pruned
+
+
+# ---------------------------------------------------------------------------
+# analytic roofline
+# ---------------------------------------------------------------------------
+
+def _active_params(dec_cfg) -> float:
+    """Params touched per token: full N for dense; for MoE, the expert
+    MLPs scale by top_k/num_experts (the rest is shared)."""
+    N = float(dec_cfg.num_params())
+    n_exp = getattr(dec_cfg, "num_experts", 0) or 0
+    if n_exp <= 1:
+        return N
+    d, h, L = dec_cfg.hidden_size, dec_cfg.ffn_size, dec_cfg.num_layers
+    mlp = (3 if dec_cfg.is_glu else 2) * d * h * L
+    expert_mlp = mlp * n_exp
+    shared = N - expert_mlp
+    top_k = getattr(dec_cfg, "num_experts_per_tok", 1) or 1
+    return shared + mlp * top_k
+
+
+def predict_candidate(dec_cfg, cand: Candidate, peaks: Peaks,
+                      seq_len: Optional[int] = None
+                      ) -> Tuple[Roofline, float]:
+    """Closed-form per-device roofline for one optimizer step of one
+    candidate, plus a serial-exposure penalty (seconds) the max() model
+    can't see. Returns ``(roofline, penalty_s)``; zero peaks yield an
+    unknown-bound roofline with ``predicted_s == 0`` — callers rank such
+    candidates behind every known-bound one and keep searching.
+
+    Counts (all per device, per optimizer step; B = micro-batch,
+    T = tokens, ga = grad-accum, dp/tp/sp/ep = mesh axes):
+
+    - FLOPs: ``(6·N_active + 6·L·q_dim·T)·B·ga·T / (tp·sp)``, scaled by
+      ``1 + recompute/3`` for the remat policy (forward ≈ ⅓ of fwd+bwd).
+    - HBM bytes: weight reads per pass (stage-3 gathers still *read*
+      full N/tp per pass), gradient accumulate traffic, optimizer-state
+      read+write over its shard, activation save/restore traffic, and
+      the CE logits round-trip.
+    - Collective bytes: ZeRO param all-gathers ((dp-1)/dp · N/tp per
+      gather; backward re-gathers double it under ``overlap_regather``),
+      grad reduce-scatter or all-reduce, Megatron-style TP all-reduces
+      (4/layer fwd+bwd), Ulysses all-to-alls (8/layer), and MoE dispatch
+      all-to-alls.
+    - Penalty: a monolithic (non-overlapped) stage-3 gather exposes
+      ~half its wire time outside the compute window (XLA's scheduler
+      hides some, not all); the chunked-overlap path with prefetch ≥ 1
+      hides it, which is exactly the trade PR 6 measured.
+    """
+    T = int(seq_len or dec_cfg.max_seq_len)
+    B, ga = cand.micro_batch, cand.grad_accum
+    dp, tp, sp, ep = cand.data, cand.model, cand.seq, cand.expert
+    L, d2 = dec_cfg.num_layers, dec_cfg.hidden_size
+    p_bytes = 2 if cand.bf16 else 4
+    N = float(dec_cfg.num_params())
+    n_act = _active_params(dec_cfg)
+    tokens = float(B * ga * T)                 # per data-parallel replica
+    recompute = REMAT_RECOMPUTE.get(cand.remat, 0.5)
+
+    flops = (6.0 * n_act + 6.0 * L * dec_cfg.q_dim * T) * tokens
+    flops *= (1.0 + recompute / 3.0)
+    flops /= (tp * sp)
+
+    # HBM traffic: weights re-read per microbatch pass (fwd + bwd +
+    # recompute), one grad accumulate write per pass, optimizer sweep
+    passes = ga * (2.0 + recompute)
+    weight_traffic = passes * N * p_bytes / tp
+    grad_traffic = ga * N * p_bytes / tp
+    opt_shard = dp if cand.zero_stage >= 1 else 1
+    opt_traffic = 2.0 * 12.0 * N / (opt_shard * tp)   # fp32 master+moments
+    act_traffic = 12.0 * L * d2 * p_bytes * tokens / sp
+    ce_traffic = 2.0 * tokens * dec_cfg.vocab_size * p_bytes / tp
+    hbm_bytes = (weight_traffic + grad_traffic + opt_traffic +
+                 act_traffic + ce_traffic)
+
+    # collectives (per-device wire bytes)
+    comm = 0.0
+    gather_bytes = 0.0
+    n_tp = N * p_bytes / tp
+    if cand.zero_stage >= 3 and dp > 1:
+        gathers = ga * (2.0 if (not cand.overlap or cand.overlap_regather)
+                        else 1.0)
+        gather_bytes = gathers * (dp - 1) / dp * n_tp
+        comm += gather_bytes
+    if dp > 1:
+        if cand.zero_stage >= 2:
+            comm += (dp - 1) / dp * n_tp               # grad reduce-scatter
+        else:
+            comm += 2.0 * (dp - 1) / dp * n_tp         # grad all-reduce
+    act_msg = tokens * d2 * p_bytes / sp
+    if tp > 1:
+        comm += 4.0 * L * 2.0 * (tp - 1) / tp * act_msg
+    if sp > 1:
+        comm += 8.0 * L * (sp - 1) / sp * act_msg
+    n_exp = getattr(dec_cfg, "num_experts", 0) or 0
+    if ep > 1 and n_exp:
+        top_k = getattr(dec_cfg, "num_experts_per_tok", 1) or 1
+        comm += 4.0 * L * top_k * (ep - 1) / ep * act_msg
+
+    rl = Roofline(flops=flops, bytes=hbm_bytes, comm_bytes=comm,
+                  peak_flops=peaks.peak_flops, hbm_bw=peaks.hbm_bw,
+                  ici_bw=peaks.ici_bw)
+    penalty_s = 0.0
+    if gather_bytes and peaks.ici_bw and not cand.overlap:
+        penalty_s = 0.5 * gather_bytes / peaks.ici_bw
+    return rl, penalty_s
+
+
+def work_proxy(rl: Roofline) -> float:
+    """Rank stand-in for unknown-bound candidates (no peaks): raw
+    work — FLOPs weighted at a nominal 100 TFLOP/s plus bytes at
+    1 TB/s — so even a CPU host with no ``--platform`` produces a
+    deterministic, monotone-in-work ordering."""
+    return rl.flops / 100e12 + (rl.bytes + rl.comm_bytes) / 1e12

@@ -570,6 +570,43 @@ class AutoscaleConfig(TPUConfigModel):
         return self
 
 
+class TuneConfig(TPUConfigModel):
+    """``"tune"`` block — the stamp ``dstpu-tune`` writes into emitted
+    configs (autotuning/tune.py:emit_config). Purely informational: it
+    records where the knobs came from (target platform/chips, the
+    winning candidate's search key, the roofline prediction) so
+    ``bench.py --from-config`` can compare predicted vs measured and
+    ``dstpu_report --compare`` can gate the drift. The engine never
+    reads it."""
+    #: True on configs emitted by dstpu-tune
+    tuned: bool = False
+    #: model preset the sweep was scored for (e.g. "llama3-8b") — lets
+    #: ``bench.py --from-config`` rebuild the same model
+    model: Optional[str] = None
+    #: target chip the peaks were modeled for (v5e/v5p/...)
+    platform: Optional[str] = None
+    #: target chip count the mesh factorizes
+    chips: Optional[int] = None
+    #: sequence length the candidate was scored at
+    seq_len: Optional[int] = None
+    #: the winning mesh shape ({axis: size})
+    mesh: Dict[str, int] = Field(default_factory=dict)
+    #: roofline-predicted step time for the winner (0/None = no model)
+    predicted_step_ms: Optional[float] = None
+    #: roofline bound of the winner (compute/memory/comm/unknown)
+    bound: Optional[str] = None
+    #: "analytic" (closed-form) or "lowered" (real XLA cost analysis)
+    source: Optional[str] = None
+    candidates_scored: Optional[int] = None
+    candidates_pruned: Optional[int] = None
+    #: deterministic candidate identity (search.Candidate.key())
+    search_key: Optional[str] = None
+    #: serving-plan engine recommendations (engine_v2 construction keys:
+    #: max_batch_tokens / prefill_chunk / max_sequences) — carried here
+    #: because they are constructor kwargs, not a config block
+    serving_engine: Dict[str, Any] = Field(default_factory=dict)
+
+
 class ResilienceConfig(TPUConfigModel):
     """``"resilience"`` block → deepspeed_tpu/resilience (fault injection
     + recovery policy; docs/resilience.md). The fault plan makes chaos
@@ -720,6 +757,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     router: RouterConfig = Field(default_factory=RouterConfig)
     autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
+    tune: TuneConfig = Field(default_factory=TuneConfig)
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)

@@ -40,11 +40,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from deepspeed_tpu.telemetry.registry import registry as _registry
 from deepspeed_tpu.telemetry.sampler import (HBM_CAPACITY, PEAK_FLOPS_BF16,
                                              PEAK_HBM_BW, hbm_capacity,
-                                             peak_flops, peak_hbm_bw)
+                                             peak_flops, peak_hbm_bw,
+                                             warn_unknown_platform)
 
 #: peak interconnect bandwidth, bytes/s per chip (public ICI specs,
 #: aggregate over the chip's links; the comm side of the roofline)
 PEAK_ICI_BW: Dict[str, float] = {
+    "v7": 1200e9, "ironwood": 1200e9,
     "v6e": 448e9, "trillium": 448e9,
     "v5p": 600e9,
     "v5e": 200e9, "v5 lite": 200e9, "v5litepod": 200e9,
@@ -239,6 +241,65 @@ def analyze_lowerable(name: str, fn: Callable, *abstract_args,
         return FunctionCost(name=name, error=f"{type(e).__name__}: {e}")
 
 
+#: per-candidate cost reuse for batch explain (dstpu-tune): the same
+#: (candidate key, function) pair is lowered once per process — the tuner
+#: re-ranks, the bench A/B re-scores, and the CLI re-renders without
+#: paying the XLA compile again
+_COST_CACHE: Dict[str, FunctionCost] = {}
+
+
+def clear_cost_cache() -> None:
+    _COST_CACHE.clear()
+
+
+def analyze_lowerable_cached(key: str, name: str, fn: Callable,
+                             *abstract_args,
+                             static_argnums=()) -> FunctionCost:
+    """:func:`analyze_lowerable` behind the per-candidate cost cache.
+    ``key`` must uniquely identify (function identity × abstract arg
+    shapes) — the tuner uses the candidate's config key. Error records
+    are cached too: a candidate that failed to lower once will fail the
+    same way again, and re-lowering it per rank pass is the cost this
+    cache exists to avoid."""
+    hit = _COST_CACHE.get(key)
+    if hit is not None:
+        return hit
+    fc = analyze_lowerable(name, fn, *abstract_args,
+                           static_argnums=static_argnums)
+    _COST_CACHE[key] = fc
+    return fc
+
+
+def roofline_from_cost(fc: FunctionCost, peaks: "Peaks") -> "Roofline":
+    """FunctionCost → Roofline against ``peaks``, degrading gracefully:
+    a record whose ``cost_analysis`` came back empty (some CPU builds)
+    or that failed to lower scores as an all-zero roofline —
+    ``bound='unknown'``, ``predicted_s == 0.0`` — instead of raising, so
+    a mid-search candidate with no numbers is kept (ranked behind every
+    known-bound candidate) and the sweep continues."""
+    if fc is None or fc.error is not None or not fc.available:
+        return Roofline(peak_flops=peaks.peak_flops, hbm_bw=peaks.hbm_bw,
+                        ici_bw=peaks.ici_bw)
+    return Roofline(flops=fc.flops, bytes=fc.bytes_accessed,
+                    comm_bytes=fc.collective_bytes,
+                    peak_flops=peaks.peak_flops, hbm_bw=peaks.hbm_bw,
+                    ici_bw=peaks.ici_bw)
+
+
+def batch_explain(items, peaks: "Peaks") -> List[Tuple[str, FunctionCost,
+                                                       "Roofline"]]:
+    """Batch-explain API for the autotuner: ``items`` is an iterable of
+    ``(key, name, fn, abstract_args)``; each entry is lowered through the
+    cost cache and scored with :func:`roofline_from_cost`. One bad
+    candidate never aborts the batch — its record carries the error and
+    an unknown-bound roofline."""
+    out = []
+    for key, name, fn, abstract_args in items:
+        fc = analyze_lowerable_cached(key, name, fn, *abstract_args)
+        out.append((key, fc, roofline_from_cost(fc, peaks)))
+    return out
+
+
 def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
     """Compile ``fn`` for the current devices and return XLA cost
     analysis (the historical ``flops_profiler.analyze_fn`` API —
@@ -354,6 +415,7 @@ def resolve_peaks(device: Any = None, platform: Optional[str] = None,
     …) — so a CPU host can model a TPU target — with per-number
     overrides on top."""
     if platform:
+        warn_unknown_platform(platform, context="resolve_peaks")
         p = Peaks(kind=platform,
                   peak_flops=_platform_lookup(PEAK_FLOPS_BF16, platform),
                   hbm_bw=_platform_lookup(PEAK_HBM_BW, platform),

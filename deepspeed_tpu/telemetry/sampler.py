@@ -18,6 +18,7 @@ from deepspeed_tpu.telemetry.registry import registry as _global_registry
 
 #: bf16 peak FLOPs/s per chip by device kind substring (public TPU specs)
 PEAK_FLOPS_BF16: Dict[str, float] = {
+    "v7": 2307e12, "ironwood": 2307e12,
     "v6e": 918e12, "trillium": 918e12,
     "v5p": 459e12,
     "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
@@ -29,6 +30,7 @@ PEAK_FLOPS_BF16: Dict[str, float] = {
 #: peak HBM bandwidth, bytes/s per chip (public TPU specs; the memory
 #: side of the roofline — see telemetry/explain.py)
 PEAK_HBM_BW: Dict[str, float] = {
+    "v7": 7370e9, "ironwood": 7370e9,
     "v6e": 1640e9, "trillium": 1640e9,
     "v5p": 2765e9,
     "v5e": 819e9, "v5 lite": 819e9, "v5litepod": 819e9,
@@ -41,6 +43,7 @@ PEAK_HBM_BW: Dict[str, float] = {
 #: — jax exposes cores as devices there). Used as the budget ceiling when
 #: the backend doesn't report ``memory_stats()['bytes_limit']``.
 HBM_CAPACITY: Dict[str, float] = {
+    "v7": 192 * 2**30, "ironwood": 192 * 2**30,
     "v6e": 32 * 2**30, "trillium": 32 * 2**30,
     "v5p": 95 * 2**30,
     "v5e": 16 * 2**30, "v5 lite": 16 * 2**30, "v5litepod": 16 * 2**30,
@@ -48,6 +51,38 @@ HBM_CAPACITY: Dict[str, float] = {
     "v3": 16 * 2**30,
     "v2": 8 * 2**30,
 }
+
+#: platforms the user has already been warned about (once per process);
+#: see :func:`warn_unknown_platform`
+_warned_platforms: set = set()
+
+
+def known_platforms() -> list:
+    """Sorted spec-table keys — the ``--platform`` values that resolve to
+    non-zero peaks (every table is keyed identically)."""
+    return sorted(PEAK_FLOPS_BF16)
+
+
+def warn_unknown_platform(name: str, context: str = "roofline") -> bool:
+    """One-time (per process, per name) warning for a ``--platform``
+    string that matches no spec-table entry. Returns True when the
+    platform IS unknown — callers degrade to zero peaks / unknown-bound
+    scoring instead of raising (an autotune sweep must not abort on a
+    typo'd or future chip name). 'cpu' is silently unknown by design."""
+    key = str(name).lower()
+    if key in ("", "cpu", "none"):
+        return key != ""
+    if any(k in key for k in PEAK_FLOPS_BF16):
+        return False
+    if key not in _warned_platforms:
+        _warned_platforms.add(key)
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "unknown platform %r for %s — no peak numbers in the spec "
+            "tables (known: %s); peaks read 0 and predictions degrade "
+            "to unknown-bound", name, context,
+            ", ".join(known_platforms()))
+    return True
 
 
 def _lookup(table: Dict[str, float], device: Any) -> float:

@@ -42,7 +42,7 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
                "fleet", "handoff", "mem", "overlap", "resilience",
-               "roofline", "router", "serving", "slo", "train")
+               "roofline", "router", "serving", "slo", "train", "tune")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
