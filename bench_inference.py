@@ -294,6 +294,145 @@ def bench_router(args) -> None:
     print(json.dumps(result))
 
 
+def bench_returning_sessions(args) -> None:
+    """tiered-KV-cache scenario: N conversation sessions are served,
+    go idle (their cached prefixes evicted from HBM), then RETURN with
+    a follow-up — with the HBM arena sized for ~N/10 resident sessions.
+    With the tier ON the evicted pages land in a bounded host-DRAM
+    arena and spill onward to NVMe; the returning request's pages are
+    prefetched at submit and re-adopted at admission, so warm resume
+    pays only the follow-up prefill. With the tier OFF the pages are
+    simply freed and every return re-prefills the full folded prompt.
+    Headline = re-prefill TTFT / warm-resume TTFT (mean over all
+    returns). Prints ONE JSON line."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference import RaggedInferenceEngineTPU
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.serving import ServingFrontend
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size = args.size or ("1b" if on_tpu else "tiny")
+    ds.build_mesh(data=1, devices=jax.devices()[:1])
+    seq_cap = 256
+    model = llama3_config(size, max_seq_len=seq_cap, tie_embeddings=True)
+    dtype = "bfloat16" if on_tpu else "float32"
+
+    rng = np.random.default_rng(0)
+    n_sessions = min(args.n_requests, 40)
+    conc = 2                    # low concurrency: sessions are IDLE, not
+    block, chunk = 16, 16       # in flight — HBM holds the working set
+    plen, new, follow, new2 = 192, 8, 16, 8
+    blocks_per_seq = -(-(plen + new) // block)        # phase-1 footprint
+    num_blocks = conc * (blocks_per_seq + 2) + 2      # ~2 cached sessions
+    hbm_sessions = num_blocks // blocks_per_seq
+    prompts = [[int(t) for t in rng.integers(0, model.vocab_size,
+                                             size=plen)]
+               for _ in range(n_sessions)]
+    follows = [[int(t) for t in rng.integers(0, model.vocab_size,
+                                             size=follow)]
+               for _ in range(n_sessions)]
+
+    eng = RaggedInferenceEngineTPU(
+        model, {"dtype": dtype, "num_blocks": num_blocks,
+                "block_size": block, "max_seq_len": seq_cap,
+                "prefill_chunk": chunk, "max_batch_tokens": 256,
+                "max_sequences": conc,
+                "use_pallas": (False if args.no_pallas else None)},
+        rng=jax.random.PRNGKey(0))
+    page_nbytes = eng.kv_page_nbytes()
+    nvme_dir = tempfile.mkdtemp(prefix="dstpu-kvtier-bench-")
+
+    def run_mode(tier_on: bool) -> dict:
+        cfg = {"kvtier": {"enabled": True, "nvme_dir": nvme_dir,
+                          "dram_bytes": 60 * page_nbytes,
+                          "high_watermark": 0.75, "low_watermark": 0.5,
+                          }} if tier_on else None
+        fe = ServingFrontend(eng, max_queue=n_sessions + conc, config=cfg)
+        steps0 = fe.metrics.counters["engine_steps"]
+        # phase 1: serve every session in small waves, then idle them out
+        # of HBM entirely (eviction captures to the tier when it's on)
+        gens = [None] * n_sessions
+        for lo in range(0, n_sessions, conc):
+            reqs = [(i, fe.submit(prompts[i], max_new_tokens=new))
+                    for i in range(lo, min(lo + conc, n_sessions))]
+            fe.run_until_idle()
+            for i, r in reqs:
+                gens[i] = list(r.tokens_out)
+        fe.cache.evict(1 << 30)
+        steps_serve = fe.metrics.counters["engine_steps"] - steps0
+        # phase 2: every session returns with a follow-up; TTFT per return
+        ttfts = []
+        for i in range(n_sessions):
+            folded = prompts[i] + gens[i] + follows[i]
+            t0 = time.perf_counter()
+            r = fe.submit(folded, max_new_tokens=new2)
+            while not r.tokens_out:
+                fe.step()
+            ttfts.append(time.perf_counter() - t0)
+            fe.run_until_idle()
+            assert len(r.tokens_out) == new2
+            # the session idles again: evict at IDLE time (captures to
+            # the tier when it's on) so the next return's latency window
+            # never pays another conversation's demotion
+            fe.cache.evict(1 << 30)
+        out = {
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 5),
+            "ttft_p50_s": round(sorted(ttfts)[len(ttfts) // 2], 5),
+            "ttft_p95_s": round(sorted(ttfts)[
+                int(0.95 * (len(ttfts) - 1))], 5),
+            "engine_steps_serve": steps_serve,
+            "engine_steps_return":
+                fe.metrics.counters["engine_steps"] - steps0 - steps_serve,
+        }
+        if tier_on:
+            st = fe.kvtier.stats()
+            out["kvtier"] = {k: st[k] for k in (
+                "captures", "spills", "adopts", "hits", "misses",
+                "prefetch_issued", "dram_pages", "nvme_pages",
+                "bytes_spilled", "bytes_adopted")}
+        fe.close()
+        fe.cache.evict(1 << 30)            # free pages for the next mode
+        return out
+
+    warm_fe = ServingFrontend(eng, max_queue=4)      # compile real buckets
+    w = warm_fe.submit(prompts[0] + [0] * (new + follow),
+                       max_new_tokens=new2)
+    warm_fe.run_until_idle()
+    assert len(w.tokens_out) == new2
+    warm_fe.close()
+    warm_fe.cache.evict(1 << 30)
+
+    off = run_mode(tier_on=False)
+    on = run_mode(tier_on=True)
+    shutil.rmtree(nvme_dir, ignore_errors=True)
+    speedup = round(off["ttft_mean_s"] / max(1e-9, on["ttft_mean_s"]), 3)
+
+    result = {
+        "metric": f"tiered KV cache llama3-{size}, {n_sessions} returning "
+                  f"sessions vs {hbm_sessions}-session HBM arena",
+        "value": round(1.0 / max(1e-9, on["ttft_mean_s"]), 2),
+        "unit": "warm resumes/s (mean 1/TTFT, tier on)",
+        "vs_baseline": speedup,
+        "extra": {
+            "resident_sessions": n_sessions,
+            "hbm_capacity_sessions": hbm_sessions,
+            "residency_ratio": round(n_sessions / max(1, hbm_sessions), 1),
+            "warm_resume_ttft_s": on["ttft_mean_s"],
+            "reprefill_ttft_s": off["ttft_mean_s"],
+            "ttft_speedup": speedup,
+            "kv_page_bytes": page_nbytes,
+            "tier_on": on, "tier_off": off,
+            "slo": _slo_extra(),
+        },
+    }
+    print(json.dumps(result))
+
+
 def bench_diurnal(args) -> None:
     """elasticity scenario: a DISAGGREGATED prefill/decode fleet under a
     diurnal load swing (10x between trough and peak) with the SLO-driven
@@ -570,7 +709,7 @@ def main() -> None:
                          "int8; int4 quarters the decode weight fetch)")
     ap.add_argument("--scenario", default="stream",
                     choices=("stream", "shared_prefix_stream", "router",
-                             "diurnal"),
+                             "diurnal", "returning_sessions"),
                     help="stream: ragged vs padded request stream; "
                          "shared_prefix_stream: serving frontend with "
                          "the radix prefix cache on vs off over "
@@ -579,7 +718,11 @@ def main() -> None:
                          "optionally under a --chaos plan; diurnal: "
                          "disaggregated prefill/decode fleet under a "
                          "10x load swing with the autoscaler sizing "
-                         "each pool and a replica killed mid-scale-down")
+                         "each pool and a replica killed mid-scale-down; "
+                         "returning_sessions: N idle sessions return "
+                         "against an HBM arena sized for N/10 — warm "
+                         "resume from the DRAM/NVMe KV tier vs full "
+                         "re-prefill TTFT")
     ap.add_argument("--replicas", type=int, default=3,
                     help="router scenario: replica pool size")
     ap.add_argument("--chaos", default=None, metavar="PLAN",
@@ -621,6 +764,8 @@ def main() -> None:
         return bench_router(args)
     if args.scenario == "diurnal":
         return bench_diurnal(args)
+    if args.scenario == "returning_sessions":
+        return bench_returning_sessions(args)
 
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from deepspeed_tpu.io.async_io import atomic_write, pread_retry
 from deepspeed_tpu.resilience.faults import fault_injector
 from deepspeed_tpu.utils.logging import logger
 
@@ -309,21 +310,8 @@ def _write_latest(save_dir: str, tag: str) -> None:
     (atomic on POSIX), then directory fsync — a crash mid-publish leaves
     either the old marker or the new one, never a torn read, and the
     marker survives power loss once this returns."""
-    path = os.path.join(save_dir, "latest")
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        fh.write(tag)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
-    try:
-        dfd = os.open(save_dir, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass  # e.g. directories not fsync-able on this filesystem
+    atomic_write(os.path.join(save_dir, "latest"), tag.encode(),
+                 durable=True)
 
 
 def _drain_pending() -> Tuple[Optional[BaseException], List[Dict[str, Any]]]:
@@ -416,11 +404,14 @@ def _read_fragment(gdir: str, f: Dict[str, Any], dtype) -> np.ndarray:
     back instead of resuming from garbage bytes."""
     path = os.path.join(gdir, f["file"])
     try:
-        with open(path, "rb") as fh:
-            raw = fh.read()
+        raw = pread_retry(path, retries=IO_RETRIES, backoff_s=IO_BACKOFF_S)
     except FileNotFoundError as e:
         raise CheckpointCorrupt(
             f"missing checkpoint fragment {f['file']}") from e
+    except OSError as e:
+        raise CheckpointCorrupt(
+            f"unreadable checkpoint fragment {f['file']} after "
+            f"{IO_RETRIES} retries: {e}") from e
     if "bytes" in f and len(raw) != int(f["bytes"]):
         raise CheckpointCorrupt(
             f"torn checkpoint fragment {f['file']}: {len(raw)} bytes on "

@@ -628,6 +628,38 @@ class ResilienceConfig(TPUConfigModel):
     serving_retry_budget: int = Field(default=2, ge=0)
 
 
+class KVTierConfig(TPUConfigModel):
+    """``"kvtier"`` block → serving/kvtier.py (vertical HBM → host DRAM
+    → NVMe page tier under the radix prefix cache; docs/serving.md
+    "Tiered KV cache"). Off by default: serving behavior is unchanged
+    until a deployment opts in to holding idle conversations' KV below
+    HBM for warm resume."""
+    #: build a KVTier under the frontend's prefix cache
+    enabled: bool = False
+    #: host-DRAM arena budget for captured page bundles (bytes)
+    dram_bytes: int = Field(default=256 << 20, ge=0)
+    #: NVMe spill directory; None → DRAM-only (watermark overflow drops
+    #: the coldest entries instead of spilling)
+    nvme_dir: Optional[str] = None
+    #: NVMe level budget (bytes); None → unbounded
+    nvme_max_bytes: Optional[int] = Field(default=None, ge=0)
+    #: DRAM usage fraction that triggers spilling …
+    high_watermark: float = Field(default=0.9, gt=0, le=1)
+    #: … and the fraction spilling drains back down to (hysteresis)
+    low_watermark: float = Field(default=0.7, gt=0, le=1)
+    #: cold-page encoding: "none" (byte-exact), "fp16" or "int8"
+    #: (EQuARX-style low-precision, halves/quarters tier footprint)
+    compress: Literal["none", "fp16", "int8"] = "none"
+
+    @model_validator(mode="after")
+    def _watermarks_ordered(self) -> "KVTierConfig":
+        if self.low_watermark > self.high_watermark:
+            raise ValueError(
+                f"kvtier.low_watermark ({self.low_watermark}) > "
+                f"kvtier.high_watermark ({self.high_watermark})")
+        return self
+
+
 class TensorBoardConfig(TPUConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -754,6 +786,7 @@ class DeepSpeedTPUConfig(TPUConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     slo: SLOConfig = Field(default_factory=SLOConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    kvtier: KVTierConfig = Field(default_factory=KVTierConfig)
     router: RouterConfig = Field(default_factory=RouterConfig)
     autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)

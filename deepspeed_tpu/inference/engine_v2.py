@@ -737,6 +737,17 @@ class RaggedInferenceEngineTPU:
                 .reshape(kvh, L * len(blocks), bs, dh)
             self.arena[key] = self.arena[key].at[:, idx].set(data)
 
+    def kv_page_nbytes(self) -> int:
+        """Host-side bytes of ONE exported KV page (all layers, k + v) —
+        what a tier/handoff consumer budgets per page (the uncompressed
+        ``export_pages`` payload size for a single block)."""
+        L = self.model_config.num_layers
+        total = 0
+        for key in ("k", "v"):
+            kvh, _, bs, dh = self.arena[key].shape
+            total += kvh * L * bs * dh * self.arena[key].dtype.itemsize
+        return total
+
     def _buckets(self, batch: RaggedBatch):
         nb = _bucket(len(batch.uids))
         c = batch.token_ids.shape[1]

@@ -38,6 +38,18 @@ class BlockedAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def live_blocks(self) -> int:
+        """Pages with at least one owner (``num_blocks - free_blocks``)."""
+        return self.num_blocks - len(self._free)
+
+    def total_refs(self) -> int:
+        """Sum of owners across every live page — with ``live_blocks``
+        the exact-accounting pair eviction/adoption tests pin down (an
+        alias adds a ref but not a live page; a tier capture must change
+        neither until the last owner lets go)."""
+        return sum(self._ref)
+
     def refcount(self, block: int) -> int:
         self._check(block)
         return self._ref[block]

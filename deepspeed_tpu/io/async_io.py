@@ -8,12 +8,76 @@ Falls back to a synchronous Python implementation without a toolchain.
 """
 
 import os
+import tempfile
 import threading
-from typing import Dict, Optional
+import time
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
 from deepspeed_tpu.ops.op_builder import is_native_available, load_async_io
+
+
+def atomic_write(path: str, data: bytes, durable: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically: tmp file + fsync + rename.
+
+    Readers never observe a torn file — they see either the old contents or
+    the complete new contents. With ``durable`` the file (and, best-effort,
+    its directory entry) are fsync'd before the rename so a crash cannot
+    leave a renamed-but-empty file. Shared by the checkpoint fragment store
+    and the KV-tier NVMe spill path.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory,
+                               prefix="." + os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            if durable:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if durable:
+        try:
+            dfd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync
+
+
+def pread_retry(path: str, size: int = -1, offset: int = 0,
+                retries: int = 3, backoff_s: float = 0.05,
+                _open: Callable = open) -> bytes:
+    """Read ``size`` bytes at ``offset`` with bounded retry on transient errors.
+
+    Retries ``OSError`` with exponential backoff up to ``retries`` attempts;
+    a missing file is not transient and surfaces immediately so callers can
+    map it to their own corruption/miss handling. Shared by the checkpoint
+    fragment reader and the KV-tier NVMe load path.
+    """
+    attempt = 0
+    while True:
+        try:
+            with _open(path, "rb") as fh:
+                if offset:
+                    fh.seek(offset)
+                return fh.read() if size < 0 else fh.read(size)
+        except FileNotFoundError:
+            raise
+        except OSError:
+            attempt += 1
+            if attempt > retries:
+                raise
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
 
 
 class AsyncIOEngine:

@@ -58,17 +58,25 @@ REPLICA_KINDS = ("replica_kill", "replica_slow")
 #: ``handoff`` site for the same reason replica kinds pin to ``router``:
 #: only the handoff path can answer them (with a decode-side re-prefill).
 HANDOFF_KINDS = ("handoff_torn", "handoff_stall")
+#: KV-tier kinds the vertical HBM→DRAM→NVMe page tier acts on while
+#: spilling/re-adopting cold radix subtrees: tear a spilled page bundle
+#: (CRC mismatch when the returning conversation loads it) or serve a
+#: stale tier entry at adopt time (the tier must drop it and force a
+#: re-prefill). Advisory, and pinned to the ``kvtier`` site: only the
+#: tier itself can answer them (with a warm-resume fallback re-prefill).
+KVTIER_KINDS = ("kvtier_torn_spill", "kvtier_stale_adopt")
 ADVISORY_KINDS = ("nonfinite_grad", "torn_fragment") + REPLICA_KINDS + \
-    HANDOFF_KINDS
+    HANDOFF_KINDS + KVTIER_KINDS
 KINDS = ACTION_KINDS + ADVISORY_KINDS
 TRIGGERS = ("step", "serving_step", "time")
 
 #: hook sites a scoped entry (``step:12:io_error:checkpoint``) may name;
 #: unscoped entries fire at any site their trigger matches (except
-#: REPLICA_KINDS, which only ever match the ``router`` site, and
-#: HANDOFF_KINDS, which only ever match the ``handoff`` site)
+#: REPLICA_KINDS, which only ever match the ``router`` site,
+#: HANDOFF_KINDS, which only ever match the ``handoff`` site, and
+#: KVTIER_KINDS, which only ever match the ``kvtier`` site)
 SITES = ("train_step", "checkpoint", "serving_step", "launcher", "router",
-         "handoff")
+         "handoff", "kvtier")
 
 
 class InjectedFault(RuntimeError):
@@ -196,6 +204,8 @@ class FaultInjector:
         if e.kind in REPLICA_KINDS and site != "router":
             return False
         if e.kind in HANDOFF_KINDS and site != "handoff":
+            return False
+        if e.kind in KVTIER_KINDS and site != "kvtier":
             return False
         if e.trigger == "step":
             return step is not None and step >= e.at
@@ -340,6 +350,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "transfer times out (bundle never arrives); the "
                         "decode replica re-prefills instead, zero token "
                         "loss")
+            elif e.kind == "kvtier_torn_spill":
+                note = (" — KV-tier drill: a spilled cold page bundle is "
+                        "torn (CRC mismatch on load); the tier drops it "
+                        "and the returning conversation re-prefills, zero "
+                        "token loss")
+            elif e.kind == "kvtier_stale_adopt":
+                note = (" — KV-tier drill: a tier entry is stale by the "
+                        "time a returning conversation adopts it; the "
+                        "tier drops it and the request re-prefills, zero "
+                        "token loss")
             print(f"  at {e.trigger}={e.at:g}{unit}: {e.kind}{scope}{note}")
         if args.explain:
             return 0

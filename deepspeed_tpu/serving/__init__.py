@@ -14,6 +14,7 @@ from deepspeed_tpu.serving.autoscaler import Autoscaler  # noqa: F401
 from deepspeed_tpu.serving.frontend import ServingFrontend, adopt_cached  # noqa: F401
 from deepspeed_tpu.serving.handoff import (PageBundle, adopt_bundle,  # noqa: F401
                                            export_bundle, verify_bundle)
+from deepspeed_tpu.serving.kvtier import KVTier, TornSpill  # noqa: F401
 from deepspeed_tpu.serving.metrics import Histogram, ServingMetrics  # noqa: F401
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from deepspeed_tpu.serving.queue import AdmissionError, AdmissionQueue  # noqa: F401
@@ -27,4 +28,4 @@ __all__ = ["ServingFrontend", "adopt_cached", "Request", "RequestState",
            "TokenBudgetPolicy", "ServingMetrics", "Histogram",
            "Router", "RouterRequest", "LocalReplica", "CircuitBreaker",
            "PageBundle", "export_bundle", "adopt_bundle", "verify_bundle",
-           "Autoscaler"]
+           "KVTier", "TornSpill", "Autoscaler"]

@@ -93,6 +93,7 @@ class ServingFrontend:
                  megastep_tokens: Optional[int] = None,
                  megastep_adaptive: Optional[bool] = None,
                  retry_budget: Optional[int] = None,
+                 kvtier=None,
                  config=None):
         self.engine = engine
         #: optional telemetry.Watchdog armed around each engine step — a
@@ -107,6 +108,29 @@ class ServingFrontend:
         self.metrics = ServingMetrics()
         self.monitor = monitor
         self.mode = mode
+        # vertical page tier under the radix cache (serving/kvtier.py):
+        # an explicit KVTier wins; else a config kvtier.* block with
+        # enabled=true builds one. Evictions then capture host-side and
+        # returning conversations warm-resume instead of re-prefilling.
+        self.kvtier = kvtier
+        if self.kvtier is None and config is not None and \
+                self.cache is not None:
+            kcfg = (config.get("kvtier") if isinstance(config, dict)
+                    else getattr(config, "kvtier", None))
+            kget = ((kcfg or {}).get if isinstance(kcfg, dict)
+                    else lambda k, d=None: getattr(kcfg, k, d))
+            if kcfg is not None and bool(kget("enabled", False)):
+                from deepspeed_tpu.serving.kvtier import KVTier
+                self.kvtier = KVTier(
+                    engine,
+                    dram_bytes=int(kget("dram_bytes", 256 << 20)),
+                    nvme_dir=kget("nvme_dir", None),
+                    nvme_max_bytes=kget("nvme_max_bytes", None),
+                    high_watermark=float(kget("high_watermark", 0.9)),
+                    low_watermark=float(kget("low_watermark", 0.7)),
+                    compress=str(kget("compress", "none") or "none"))
+        if self.cache is not None and self.kvtier is not None:
+            self.cache.tier = self.kvtier
         self.token_budget = token_budget     # None → engine max_batch_tokens
         # decode-megastep knobs: explicit kwargs win over a passed
         # DeepSpeedTPUConfig/dict (its serving.* block), which wins over
@@ -201,11 +225,17 @@ class ServingFrontend:
                     self._history = self._slo = None
 
     def close(self) -> None:
-        """Release frontend-owned resources (the /metrics server);
-        idempotent, safe to call on a frontend that never opened one."""
+        """Release frontend-owned resources (the /metrics server, the
+        KV tier's I/O engine and spill files); idempotent, safe to call
+        on a frontend that never opened either."""
         if self._http is not None:
             self._http.close()
             self._http = None
+        if self.kvtier is not None:
+            if self.cache is not None:
+                self.cache.tier = None    # no capture churn at teardown
+            self.kvtier.close()
+            self.kvtier = None
 
     def terminate_inflight(self, reason: str = "drained") -> int:
         """Finish every running AND queued request with ``reason``
@@ -311,6 +341,11 @@ class ServingFrontend:
             self.metrics.bump("shed")
             self._trace_lifecycle(victim, "deadline", now)
         self.metrics.bump("admitted")
+        if self.kvtier is not None:
+            # returning conversation: start the NVMe preads NOW (the PR 6
+            # issue/complete split) so the bytes climb to DRAM while the
+            # request waits in admission; the complete half runs at admit
+            self.kvtier.issue_prefetch(prompt)
         return req
 
     def cancel(self, req: Request) -> None:
@@ -324,6 +359,17 @@ class ServingFrontend:
         if len(eng.state.seqs) >= eng.config.max_sequences:
             self.queue._q.insert(0, req)
             return False
+        if self.kvtier is not None and self.cache is not None:
+            # complete half of the tier prefetch: restore the prompt's
+            # spilled chain into arena + radix cache BEFORE the normal
+            # cached-prefix adoption aliases it — a warm resume then
+            # prefills only the uncovered suffix. The tier degrades to a
+            # plain re-prefill on any failure; admission never does.
+            try:
+                self.kvtier.adopt(req.prompt, self.cache)
+            except Exception as e:                   # noqa: BLE001
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(f"kvtier adopt failed (re-prefilling): {e}")
         try:
             matched = adopt_cached(eng, self.cache, req.uid, req.prompt)
         except RuntimeError:
@@ -702,6 +748,8 @@ class ServingFrontend:
         if self.cache is not None:
             out["prefix_hit_rate"] = self.cache.hit_rate
             out["prefix_pages_cached"] = self.cache.pages_cached
+        if self.kvtier is not None:
+            out["kvtier"] = self.kvtier.stats()
         if self._slo is not None:
             out["slo"] = self._slo.summary()
         return out

@@ -55,18 +55,31 @@ class PrefixMatch:
 
 class PrefixCache:
 
-    def __init__(self, allocator, max_pages: Optional[int] = None):
+    def __init__(self, allocator, max_pages: Optional[int] = None,
+                 tier=None):
         self.allocator = allocator
         self.block_size = allocator.block_size
         #: soft page cap; None → up to half the arena
         self.max_pages = (max_pages if max_pages is not None
                           else max(1, allocator.num_blocks // 2))
+        #: optional vertical page tier (serving/kvtier.KVTier): eviction
+        #: captures the page host-side BEFORE the allocator ref drops
+        self.tier = tier
         self._root = _Node((), None, None)
         self._clock = 0
         self.pages_cached = 0
         self.lookups = 0
         self.hits = 0
         self.tokens_hit = 0
+        #: eviction accounting, kept separately so a page that moved to
+        #: the tier AND returned to the pool is never counted twice as
+        #: "freed": ``pages_released`` counts pages the allocator
+        #: actually reclaimed (refcount hit zero — free_blocks grew by
+        #: exactly this much); ``pages_tiered`` counts pages whose KV
+        #: entered the tier. A shared CoW prefix can be tiered while a
+        #: live sequence keeps the physical page (tiered +1, released +0).
+        self.pages_released = 0
+        self.pages_tiered = 0
 
     # -- lookup ------------------------------------------------------------
 
@@ -155,6 +168,25 @@ class PrefixCache:
 
     # -- eviction ----------------------------------------------------------
 
+    def _token_path(self, node: _Node) -> List[int]:
+        """Reconstruct the exact token prefix a trie node's page covers
+        (root → node chunk concatenation) — the tier key for a captured
+        page."""
+        chunks: List[Tuple[int, ...]] = []
+        while node is not None and node.parent is not None:
+            chunks.append(node.chunk)
+            node = node.parent
+        return [t for chunk in reversed(chunks) for t in chunk]
+
+    def _release(self, block: int, tokens: Optional[List[int]]) -> None:
+        """Drop the cache's ref on one page, optionally capturing its KV
+        into the tier first (the export must happen while the page is
+        still live in the arena). Updates the split eviction accounting."""
+        if self.tier is not None and tokens:
+            if self.tier.capture(tokens, block):
+                self.pages_tiered += 1
+        self.pages_released += self.allocator.free([block])
+
     def _leaves(self, node: _Node, out: List[Tuple[int, object, object]]):
         for span, rec in node.partials.items():
             out.append((rec[1], node, span))
@@ -183,28 +215,37 @@ class PrefixCache:
             leaves.sort(key=lambda t: t[0])
             _, parent, what = leaves[0]
             if isinstance(what, _Node):
-                self.allocator.free([what.block])
+                self._release(what.block, self._token_path(what))
                 del parent.children[what.chunk]
             else:                           # partial span key
-                self.allocator.free([parent.partials[what][0]])
+                self._release(parent.partials[what][0],
+                              self._token_path(parent) + list(what))
                 del parent.partials[what]
             self.pages_cached -= 1
             dropped += 1
         return dropped
 
-    def _free_subtree(self, node: _Node) -> int:
-        """Free every page below ``node`` (not ``node`` itself)."""
-        n = 0
+    def _free_subtree(self, node: _Node) -> Tuple[int, int]:
+        """Drop the cache's ref on every page below ``node`` (not
+        ``node`` itself). Returns ``(dropped, released)``: refs this
+        cache let go vs pages the ALLOCATOR actually reclaimed
+        (refcount hit zero). The two must be reported separately —
+        a page a live sequence still shares is dropped-but-not-released,
+        and conflating them double-counts the pool. Fault path: pages
+        are NEVER captured to the tier here (their KV is suspect)."""
+        n = rel = 0
         for rec in node.partials.values():
-            self.allocator.free([rec[0]])
+            rel += self.allocator.free([rec[0]])
             n += 1
         node.partials.clear()
         for child in node.children.values():
-            n += self._free_subtree(child)
-            self.allocator.free([child.block])
+            cn, crel = self._free_subtree(child)
+            n += cn
+            rel += crel
+            rel += self.allocator.free([child.block])
             n += 1
         node.children.clear()
-        return n
+        return n, rel
 
     def invalidate(self, tokens: List[int]) -> int:
         """Drop every cached page reachable through ``tokens``' first
@@ -212,7 +253,10 @@ class PrefixCache:
         fault may have left a request's KV suspect. A corrupt prefix
         page poisons every cached extension of it, so the whole subtree
         goes (over-invalidation only costs recompute; serving stale KV
-        costs correctness). Returns pages dropped."""
+        costs correctness). The tier's copies of the prefix are exactly
+        as suspect, so they go too (and are never re-captured from
+        here). Returns pages dropped; pages the allocator actually
+        reclaimed accrue to ``pages_released``."""
         self._clock += 1
         dropped = 0
         root = self._root
@@ -220,17 +264,22 @@ class PrefixCache:
                if len(tokens) >= self.block_size else None)
         child = root.children.get(key) if key is not None else None
         if child is not None:
-            dropped += self._free_subtree(child)
-            self.allocator.free([child.block])
+            sub_n, sub_rel = self._free_subtree(child)
+            dropped += sub_n
+            self.pages_released += sub_rel
+            self.pages_released += self.allocator.free([child.block])
             del root.children[key]
             dropped += 1
         for span in [s for s in list(root.partials)
                      if len(s) <= len(tokens)
                      and tuple(tokens[:len(s)]) == s]:
-            self.allocator.free([root.partials[span][0]])
+            self.pages_released += self.allocator.free(
+                [root.partials[span][0]])
             del root.partials[span]
             dropped += 1
         self.pages_cached -= dropped
+        if self.tier is not None:
+            self.tier.invalidate(tokens)
         return dropped
 
     def owned_blocks(self) -> List[int]:

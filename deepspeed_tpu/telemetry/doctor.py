@@ -172,7 +172,8 @@ def analyze(dumps: List[Dict[str, Any]],
                                  "router_drained", "router_handoff",
                                  "router_handoff_fallback",
                                  "router_replica_added", "autoscale_up",
-                                 "autoscale_down"):
+                                 "autoscale_down", "kvtier_spill",
+                                 "kvtier_adopt", "kvtier_fallback"):
                 recovery_timeline.append({**e, "host": _host_name(doc, i)})
     recovery_timeline.sort(key=lambda e: (e.get("ts", 0.0),
                                           e.get("step") or 0))

@@ -182,6 +182,7 @@ class HostSample:
             "stale_s": None if self.ts is None else max(0.0, now - self.ts),
             "router": router_states(m),
             "autoscale": autoscale_targets(m),
+            "kvtier": kvtier_state(m),
         }
 
 
@@ -200,6 +201,22 @@ def router_states(metrics: Dict[str, Any]) -> Optional[Dict[str, str]]:
             states[m.group(1)] = ROUTER_STATES.get(float(val),
                                                    f"state_{val:g}")
     return dict(sorted(states.items())) or None
+
+
+def kvtier_state(metrics: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """Host-tier residency + flow from a host's parsed exposition
+    (``kvtier_*`` gauges/counters published by serving/kvtier.py); None
+    when the host runs no KV tier."""
+    out = {}
+    for short, name in (("dram", "kvtier_dram_pages"),
+                        ("nvme", "kvtier_nvme_pages"),
+                        ("hits", "kvtier_hits"),
+                        ("spills", "kvtier_spills"),
+                        ("adopts", "kvtier_adopts")):
+        v = metrics.get(name)
+        if isinstance(v, (int, float)):
+            out[short] = float(v)
+    return out or None
 
 
 def autoscale_targets(metrics: Dict[str, Any]) -> \
@@ -354,6 +371,10 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
                 f"{pool}={d.get('live', '?')}/{d.get('target', '?')}"
                 for pool, d in r["autoscale"].items())
             lines.append(f"    └─ autoscale (live/target): {pairs}")
+        if r.get("kvtier"):
+            pairs = " ".join(f"{k}={v:g}"
+                             for k, v in r["kvtier"].items())
+            lines.append(f"    └─ kvtier: {pairs}")
     degraded = sum(1 for r in rows if r["status"] not in ("ok",))
     lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
                  f"(* = interval percentile, ms)")

@@ -41,8 +41,9 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
-               "fleet", "handoff", "mem", "overlap", "resilience",
-               "roofline", "router", "serving", "slo", "train", "tune")
+               "fleet", "handoff", "kvtier", "mem", "overlap",
+               "resilience", "roofline", "router", "serving", "slo",
+               "train", "tune")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
