@@ -71,6 +71,29 @@ def _slo_extra() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _trace_exemplars_extra() -> dict:
+    """Worst-TTFT / worst-TPOT exemplar trace_ids for the BENCH JSON
+    line (request tracing's latency exemplars — telemetry/reqtrace):
+    the exact traces to open with ``dstpu-trace --request`` when this
+    run's tail regresses. {} when tracing is off or no exemplar was
+    recorded; never breaks the headline JSON."""
+    try:
+        from deepspeed_tpu.telemetry.registry import registry
+        out = {}
+        for short, name in (("worst_ttft", "serving/ttft_seconds"),
+                            ("worst_tpot", "serving/tpot_seconds"),
+                            ("worst_router_ttft", "router/ttft_seconds")):
+            m = registry.get(name)
+            ex = (m.worst_exemplar()
+                  if hasattr(m, "worst_exemplar") else None)
+            if ex is not None:
+                out[short] = {"trace_id": ex[0],
+                              "value_s": round(ex[1], 6)}
+        return out
+    except Exception:                                # noqa: BLE001
+        return {}
+
+
 def bench_shared_prefix(args) -> None:
     """serving-frontend scenario: a stream of prompts sharing a 50%
     prefix (system prompt / few-shot preamble), served through
@@ -153,6 +176,7 @@ def bench_shared_prefix(args) -> None:
             "ttft_mean_s": round(fe_hot.metrics.ttft.mean, 4),
             "roofline": _roofline_extra(eng),
             "slo": _slo_extra(),
+            "trace_exemplars": _trace_exemplars_extra(),
         },
     }
     print(json.dumps(result))
@@ -287,6 +311,7 @@ def bench_router(args) -> None:
             "chaos": args.chaos,
             **headline,
             "slo": _slo_extra(),
+            "trace_exemplars": _trace_exemplars_extra(),
         },
     }
     if hedge_ab is not None:
@@ -428,6 +453,7 @@ def bench_returning_sessions(args) -> None:
             "kv_page_bytes": page_nbytes,
             "tier_on": on, "tier_off": off,
             "slo": _slo_extra(),
+            "trace_exemplars": _trace_exemplars_extra(),
         },
     }
     print(json.dumps(result))
@@ -685,6 +711,7 @@ def bench_diurnal(args) -> None:
             "ledger": {"faults": faults, "recoveries": recoveries,
                        "balanced": faults == recoveries},
             "slo": _slo_extra(),
+            "trace_exemplars": _trace_exemplars_extra(),
         },
     }
     if tune_extra is not None:
@@ -967,6 +994,7 @@ def main() -> None:
             },
             "roofline": _roofline_extra(v2),
             "slo": _slo_extra(),
+            "trace_exemplars": _trace_exemplars_extra(),
         },
     }
     if megastep_extra is not None:

@@ -389,6 +389,25 @@ class WatchdogConfig(TPUConfigModel):
     heartbeat_file: Optional[str] = None
 
 
+class ReqTraceConfig(TPUConfigModel):
+    """``"telemetry": {"reqtrace": {...}}`` → telemetry/reqtrace.py:
+    request-scoped distributed tracing with tail-based sampling. Spans a
+    request's legs emit (router dispatch, hedge races, failover replays,
+    prefill→decode handoff, kvtier prefetch/adopt) are buffered per
+    trace_id and retained only when the request ended *interesting* —
+    SLO-slow, errored/drained, or flagged (failover/hedge/reprefill/
+    kvtier-fallback) — plus a configurable head-sample rate."""
+    enabled: bool = False
+    #: fraction of traces retained regardless of outcome (deterministic
+    #: by trace_id, so every host keeps the same traces)
+    head_sample: float = Field(default=0.0, ge=0.0, le=1.0)
+    #: a TTFT or TPOT at/over this retains the trace (0 disables the
+    #: latency trigger; flags and error reasons still retain)
+    retain_slow_ms: float = Field(default=500.0, ge=0.0)
+    #: in-flight traces buffered per host; oldest evicted beyond this
+    buffer_traces: int = Field(default=256, ge=1)
+
+
 class TelemetryConfig(TPUConfigModel):
     """``"telemetry"`` block → deepspeed_tpu/telemetry (tracer + registry +
     samplers + diagnostics). Metrics recording and the flight recorder are
@@ -415,6 +434,9 @@ class TelemetryConfig(TPUConfigModel):
     #: warn once a single function has been retraced this many times
     compile_storm_threshold: int = Field(default=8, ge=1)
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    #: request-scoped distributed tracing (its own ``enabled`` gate,
+    #: independent of span tracing) — telemetry/reqtrace.py
+    reqtrace: ReqTraceConfig = Field(default_factory=ReqTraceConfig)
     #: serve ``GET /metrics`` + ``GET /healthz`` on this port (0 =
     #: ephemeral; None = no server) — telemetry/endpoint.py
     http_port: Optional[int] = Field(default=None, ge=0)

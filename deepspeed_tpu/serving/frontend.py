@@ -294,14 +294,21 @@ class ServingFrontend:
                timeout: Optional[float] = None,
                deadline: Optional[float] = None,
                stream_cb=None,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               ctx=None) -> Request:
         """Admit a request or raise :class:`AdmissionError` with a reason
         (``queue_full`` | ``kv_exhausted`` | ``too_long`` |
         ``slo_unattainable``) — overload is surfaced at the door, not
         buffered into unbounded latency. ``slo_unattainable`` fires only
         with SLO admission on and a deadline the roofline model says
         cannot be met even best-case. ``eos_token_id`` finishes the
-        request early (reason ``"eos"``) when that token is sampled."""
+        request early (reason ``"eos"``) when that token is sampled.
+
+        ``ctx`` is an upstream :class:`~deepspeed_tpu.telemetry.reqtrace.
+        TraceContext` (the router passes its leg context so this
+        frontend's spans join the fleet-wide trace); with request tracing
+        enabled and no upstream context, the frontend is the entry point
+        and mints the trace itself."""
         now = self.clock()
         prompt = [int(t) for t in prompt]
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
@@ -341,11 +348,14 @@ class ServingFrontend:
             self.metrics.bump("shed")
             self._trace_lifecycle(victim, "deadline", now)
         self.metrics.bump("admitted")
+        from deepspeed_tpu.telemetry.reqtrace import reqtrace
+        req.trace = ctx if ctx is not None else \
+            reqtrace.mint(entry="frontend", uid=req.uid)
         if self.kvtier is not None:
             # returning conversation: start the NVMe preads NOW (the PR 6
             # issue/complete split) so the bytes climb to DRAM while the
             # request waits in admission; the complete half runs at admit
-            self.kvtier.issue_prefetch(prompt)
+            self.kvtier.issue_prefetch(prompt, ctx=req.trace)
         return req
 
     def cancel(self, req: Request) -> None:
@@ -366,7 +376,7 @@ class ServingFrontend:
             # prefills only the uncovered suffix. The tier degrades to a
             # plain re-prefill on any failure; admission never does.
             try:
-                self.kvtier.adopt(req.prompt, self.cache)
+                self.kvtier.adopt(req.prompt, self.cache, ctx=req.trace)
             except Exception as e:                   # noqa: BLE001
                 from deepspeed_tpu.utils.logging import logger
                 logger.warning(f"kvtier adopt failed (re-prefilling): {e}")
@@ -453,7 +463,13 @@ class ServingFrontend:
                 progressed = True
         while self._try_admit_one(now):
             progressed = True
-        self.metrics.queue_depth.record(float(len(self.queue)))
+        # queue-depth exemplar: the head-of-line request's trace — the
+        # one that has been waiting at this depth the longest
+        head = self.queue._q[0] if len(self.queue) else None
+        self.metrics.queue_depth.record(
+            float(len(self.queue)),
+            exemplar=head.trace.trace_id
+            if head is not None and head.trace else None)
         k = self._pick_megastep(now)
         row_limits = eos_map = None
         if k > 1:
@@ -511,7 +527,9 @@ class ServingFrontend:
                 toks = [toks]
             if req.first_token_ts is None:
                 req.first_token_ts = now
-                self.metrics.ttft.record(now - (req.enqueue_ts or now))
+                self.metrics.ttft.record(
+                    now - (req.enqueue_ts or now),
+                    exemplar=req.trace.trace_id if req.trace else None)
                 if self.cache is not None:
                     # prefill done → every prompt page holds valid KV;
                     # publish them (cache increfs what it keeps)
@@ -520,6 +538,12 @@ class ServingFrontend:
             if len(toks) > 1:
                 self.metrics.bump("megasteps")
                 self.metrics.megastep_k.record(float(len(toks)))
+                # one marker per fused pump on the request's trace track:
+                # a megastep-starved stream shows sparse pumps, not a
+                # mystery gap between prefill and finish
+                telemetry.reqtrace.instant(
+                    "serving/request/megastep", req.trace, ts=now,
+                    tid=req.uid, k=len(toks))
             finished = False
             for tok in toks:
                 tok = int(tok)
@@ -578,7 +602,9 @@ class ServingFrontend:
         req.finish_ts = now
         self._trace_lifecycle(req, reason, now)
         if req.tpot is not None:
-            self.metrics.tpot.record(req.tpot)
+            self.metrics.tpot.record(
+                req.tpot,
+                exemplar=req.trace.trace_id if req.trace else None)
         if state is RequestState.FINISHED:
             self.metrics.bump("completed")
         elif state is RequestState.CANCELLED:
@@ -622,6 +648,11 @@ class ServingFrontend:
                 req.prompt = req.prompt + req.tokens_out
                 req.state = RequestState.QUEUED
                 req.first_token_ts = None
+                telemetry.reqtrace.flag(req.trace, "replay")
+                telemetry.reqtrace.instant(
+                    "serving/request/replay", req.trace, ts=now,
+                    tid=req.uid, replay=req.retries,
+                    error=type(err).__name__)
                 self.queue._q.insert(0, req)
                 self.metrics.bump("requeued_engine_fault")
                 telemetry.registry.counter(
@@ -659,7 +690,39 @@ class ServingFrontend:
         (queued → prefill → decode, plus the whole-request envelope), one
         trace track per request (tid = uid). The frontend's clock and the
         tracer's are both CLOCK_MONOTONIC-derived, so the retroactive
-        timestamps land on the tracer's timeline (see Tracer.complete)."""
+        timestamps land on the tracer's timeline (see Tracer.complete).
+
+        With a trace context on the request, the spans go through the
+        tail-sampling :class:`~deepspeed_tpu.telemetry.reqtrace.ReqTrace`
+        buffer instead (trace_id-tagged; retained or dropped whole at the
+        root owner's ``finish``); without one, the legacy path records
+        untagged spans straight into the tracer ring."""
+        rt = telemetry.reqtrace
+        ctx = req.trace
+        if ctx is not None and rt.enabled:
+            if req.enqueue_ts is None:
+                return
+            tid = req.uid
+            rt.complete("serving/request", ctx, req.enqueue_ts, now,
+                        tid=tid, envelope=True, reason=reason,
+                        tokens_out=len(req.tokens_out),
+                        cached_tokens=req.cached_tokens,
+                        replay=req.retries)
+            if req.schedule_ts is not None:
+                rt.complete("serving/request/queued", ctx, req.enqueue_ts,
+                            req.schedule_ts, tid=tid)
+                if req.first_token_ts is not None:
+                    rt.complete("serving/request/prefill", ctx,
+                                req.schedule_ts, req.first_token_ts,
+                                tid=tid)
+                    rt.complete("serving/request/decode", ctx,
+                                req.first_token_ts, now, tid=tid)
+            if ctx.root:
+                # this frontend minted the trace — the stream ends here,
+                # so the tail-sampling decision is ours
+                rt.finish(ctx, reason=reason, ttft_s=req.ttft,
+                          tpot_s=req.tpot)
+            return
         tr = telemetry.tracer
         if not tr.enabled or req.enqueue_ts is None:
             return

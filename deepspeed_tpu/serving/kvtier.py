@@ -45,6 +45,7 @@ faults==recoveries ledger with a ``kvtier_reprefill`` recovery.
 import json
 import os
 import struct
+import time
 import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -427,11 +428,13 @@ class KVTier:
 
     # -- prefetch (issue half) ----------------------------------------------
 
-    def issue_prefetch(self, prompt: List[int]) -> int:
+    def issue_prefetch(self, prompt: List[int], ctx=None) -> int:
         """Start async preads for every NVMe-resident page of the
         prompt's chain — fire-and-forget at ``submit`` time so the bytes
         climb to DRAM while the request waits in admission. Returns
-        preads issued (0 for an all-DRAM chain: nothing to do)."""
+        preads issued (0 for an all-DRAM chain: nothing to do). ``ctx``
+        (the request's TraceContext) stamps the issue into the request's
+        distributed trace."""
         issued = 0
         for entry in self._match_chain(prompt):
             if entry.path is None or entry.key in self._inflight:
@@ -444,6 +447,13 @@ class KVTier:
             self.counters["prefetch_issued"] += issued
             _count("kvtier/prefetch_issued", issued,
                    help="NVMe tier preads issued ahead of admission")
+            if ctx is not None:
+                try:
+                    from deepspeed_tpu.telemetry.reqtrace import reqtrace
+                    reqtrace.instant("kvtier/prefetch", ctx,
+                                     issued=issued)
+                except Exception:                    # noqa: BLE001
+                    pass
         return issued
 
     # -- adopt (complete half) ----------------------------------------------
@@ -468,23 +478,45 @@ class KVTier:
                             "(stale or swapped file)")
         return _decode(payload, entry.meta)
 
-    def _fallback(self, kind: str, prompt_len: int) -> None:
+    def _fallback(self, kind: str, prompt_len: int, ctx=None) -> None:
         """One torn/stale fault handled: the returning conversation will
         re-prefill the uncovered suffix instead. Counts the fallback and
-        closes the chaos ledger (one recovery per injected fault)."""
+        closes the chaos ledger (one recovery per injected fault). With
+        ``ctx``, additionally flags the request's trace interesting —
+        kvtier fallbacks are tail-retention causes."""
         self.counters["fallback_reprefills"] += 1
         _count("kvtier/fallback_reprefills",
                help="tier adoptions abandoned for a re-prefill")
         _event("kvtier_fallback", cause=kind, prompt_len=prompt_len)
         record_recovery("kvtier_reprefill", cause=kind,
                         prompt_len=prompt_len)
+        if ctx is not None:
+            try:
+                from deepspeed_tpu.telemetry.reqtrace import reqtrace
+                reqtrace.flag(ctx, "kvtier_fallback")
+                reqtrace.instant("kvtier/fallback", ctx, cause=kind)
+            except Exception:                        # noqa: BLE001
+                pass
 
-    def adopt(self, prompt: List[int], cache) -> int:
+    def adopt(self, prompt: List[int], cache, ctx=None) -> int:
         """Restore the prompt's tier chain into the arena + radix cache.
         Returns pages the cache now additionally holds (0 → nothing
         restored; the caller's normal prefill covers the rest). Pages
         leave the tier only once the cache owns them — a declined insert
-        (page cap) keeps the entry for the next return."""
+        (page cap) keeps the entry for the next return. ``ctx`` stamps a
+        ``kvtier/adopt`` span into the request's distributed trace."""
+        t0 = time.monotonic()
+        added = self._adopt(prompt, cache, ctx=ctx)
+        if ctx is not None and added:
+            try:
+                from deepspeed_tpu.telemetry.reqtrace import reqtrace
+                reqtrace.complete("kvtier/adopt", ctx, t0,
+                                  time.monotonic(), pages=added)
+            except Exception:                        # noqa: BLE001
+                pass
+        return added
+
+    def _adopt(self, prompt: List[int], cache, ctx=None) -> int:
         chain = self._match_chain(prompt)
         if not chain:
             if self._entries:
@@ -512,7 +544,7 @@ class KVTier:
             self.counters["stale_adopts"] += n
             _count("kvtier/stale_adopts", n,
                    help="tier entries dropped as stale at adoption")
-            self._fallback("kvtier_stale_adopt", len(prompt))
+            self._fallback("kvtier_stale_adopt", len(prompt), ctx=ctx)
             self._publish()
             return 0
         if self._inflight:
@@ -529,7 +561,7 @@ class KVTier:
                 _count("kvtier/torn_spills",
                        help="tier entries lost to torn spills (CRC)")
                 self._drop_subtree(entry.key)
-                self._fallback("kvtier_torn_spill", len(prompt))
+                self._fallback("kvtier_torn_spill", len(prompt), ctx=ctx)
                 if not isinstance(e, TornSpill):
                     self._drop(entry, reason="io_error")
                 break

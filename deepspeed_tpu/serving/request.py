@@ -65,6 +65,12 @@ class Request:
     #: ``"error"`` (streamed to the client, never a hang).
     retries: int = 0
 
+    #: distributed-trace identity (:class:`~deepspeed_tpu.telemetry.
+    #: reqtrace.TraceContext`): minted by the frontend when it is the
+    #: entry point, or passed in by the router so this leg's spans join
+    #: the fleet-wide trace. None when request tracing is disabled.
+    trace: Optional[object] = field(default=None, repr=False)
+
     _cancel: bool = field(default=False, repr=False)
 
     def cancel(self) -> None:

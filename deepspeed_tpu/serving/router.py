@@ -287,6 +287,10 @@ class _Assignment:
     #: which disaggregated leg this is: "mono" (homogeneous fleet),
     #: "prefill" (one-token leg whose pages hand off) or "decode"
     role: str = "mono"
+    #: this leg's TraceContext (child of the request's root) — the
+    #: identity the replica's frontend stamps into its spans; None when
+    #: request tracing is disabled
+    ctx: Optional[object] = None
 
 
 @dataclass
@@ -313,6 +317,11 @@ class RouterRequest:
     phase: str = "mono"
     #: prompt tokens the decode replica served from handed-off pages
     handoff_tokens: int = 0
+    #: distributed-trace root context (:class:`~deepspeed_tpu.telemetry.
+    #: reqtrace.TraceContext`), minted at :meth:`Router.submit`; every
+    #: dispatch leg forks a child from it. The router owns the tail
+    #: decision (``reqtrace.finish``) for router-entered requests.
+    trace: Optional[object] = field(default=None, repr=False)
 
     submit_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
@@ -596,7 +605,19 @@ class Router:
             eos_token_id=eos_token_id)
         req.submit_ts = now
         req.phase = "prefill" if self.disaggregated else "mono"
-        self._dispatch(req, exclude=())
+        req.trace = telemetry.reqtrace.mint(entry="router", uid=req.uid)
+        try:
+            self._dispatch(req, exclude=())
+        except AdmissionError as e:
+            # rejected before any leg ran — the trace still records WHY
+            # (breaker states are in the per-attempt router/rejected
+            # instants) and finishes honestly instead of leaking
+            rt = telemetry.reqtrace
+            rt.flag(req.trace, "rejected")
+            rt.instant("router/rejected", req.trace, tid=req.uid,
+                       reason=e.reason, terminal=1)
+            rt.finish(req.trace, reason=e.reason)
+            raise
         self._reqs[req.uid] = req
         _registry.counter("router/requests",
                           help="streams admitted by the router").inc()
@@ -631,19 +652,36 @@ class Router:
                 replica, prefer = prefer, None
             else:
                 replica = self._choose(folded, exclude=tried, pool=pool)
+            kw: Dict[str, Any] = dict(
+                max_new_tokens=inner_max, priority=req.priority,
+                deadline=req.deadline, eos_token_id=req.eos_token_id)
+            if req.trace is not None:
+                # fork this leg's trace context: the replica's frontend
+                # stamps its spans with it, so the fleet-wide trace has
+                # one child span-tree per dispatch attempt. Omitted
+                # entirely when tracing is off (plain frontends and test
+                # stubs need not know the kwarg exists).
+                leg: Dict[str, Any] = {"replica": replica.name,
+                                       "role": role}
+                if hedge:
+                    leg["hedge"] = 1
+                if req.failovers:
+                    leg["replay"] = req.failovers
+                kw["ctx"] = req.trace.child(**leg)
             try:
-                inner = replica.submit(
-                    folded, max_new_tokens=inner_max,
-                    priority=req.priority, deadline=req.deadline,
-                    eos_token_id=req.eos_token_id)
+                inner = replica.submit(folded, **kw)
             except AdmissionError as e:
                 last_err = e
                 tried = tried + (replica.name,)
+                telemetry.reqtrace.instant(
+                    "router/rejected", req.trace, tid=req.uid,
+                    replica=replica.name, reason=e.reason)
                 self.breakers[replica.name].record_failure(
                     f"submit rejected: {e.reason}")
                 continue
             assign = _Assignment(replica=replica, inner=inner,
-                                 dispatch_ts=self.clock(), role=role)
+                                 dispatch_ts=self.clock(), role=role,
+                                 ctx=kw.get("ctx"))
             if hedge:
                 req.hedge = assign
             else:
@@ -731,6 +769,12 @@ class Router:
             _registry.counter(
                 "router/hedges_won",
                 help="hedge legs that delivered the stream").inc()
+            if req.primary.ctx is not None:
+                req.primary.ctx.baggage["winner"] = 1
+                telemetry.reqtrace.instant(
+                    "router/hedge_won", req.primary.ctx, tid=req.uid,
+                    replica=req.primary.replica.name, winner=1)
+            telemetry.reqtrace.flag(req.trace, "failover")
             return
         req.failovers += 1
         # a stream cut because its replica was intentionally drained is
@@ -763,6 +807,12 @@ class Router:
         _registry.counter(
             "router/failovers",
             help="mid-stream re-dispatches after replica failure").inc()
+        telemetry.reqtrace.flag(req.trace, "failover")
+        telemetry.reqtrace.instant(
+            "router/failover", req.trace, tid=req.uid,
+            replica=from_name, to=req.primary.replica.name,
+            reason=reason, replay=req.failovers,
+            replayed_tokens=len(req.tokens_out))
         telemetry.flight_recorder.record_event(
             "router_failover", replica=from_name,
             to=req.primary.replica.name, uid=req.uid, reason=reason,
@@ -852,6 +902,20 @@ class Router:
                 _registry.counter(
                     "router/hedges_won" if won else "router/hedges_lost",
                     help="hedge race outcomes").inc()
+                # tag both racing legs: winner/loser markers, plus
+                # ``winner`` baggage so spans the legs emit from here on
+                # carry it (critical_path drops winner==0 spans — the
+                # loser ran off the critical path)
+                if req.winner.ctx is not None:
+                    req.winner.ctx.baggage["winner"] = 1
+                    telemetry.reqtrace.instant(
+                        "router/hedge_won", req.winner.ctx, tid=req.uid,
+                        replica=req.winner.replica.name, winner=1)
+                if loser.ctx is not None:
+                    loser.ctx.baggage["winner"] = 0
+                    telemetry.reqtrace.instant(
+                        "router/hedge_lost", loser.ctx, tid=req.uid,
+                        replica=loser.replica.name, winner=0)
                 loser.replica.cancel(loser.inner)
                 if won:
                     req.primary, req.hedge = req.hedge, None
@@ -941,6 +1005,11 @@ class Router:
                 "router/hedge", uid=req.uid,
                 primary=req.primary.replica.name,
                 hedge=req.hedge.replica.name)
+            telemetry.reqtrace.flag(req.trace, "hedge")
+            telemetry.reqtrace.instant(
+                "router/hedge", req.trace, tid=req.uid,
+                primary=req.primary.replica.name,
+                hedge=req.hedge.replica.name)
             # the first hedge raced against a chaos-slowed replica IS
             # that fault's recovery: the mitigation engaged and the
             # tail request no longer waits on the degraded replica
@@ -969,7 +1038,10 @@ class Router:
         assign.drained = len(inner_toks)
         if req.first_token_ts is None:
             req.first_token_ts = now
-            self.ttft.record(max(0.0, now - (req.submit_ts or now)))
+            self.ttft.record(
+                max(0.0, now - (req.submit_ts or now)),
+                exemplar=(req.trace.trace_id
+                          if req.trace is not None else None))
         req.tokens_out.extend(int(t) for t in new)
         req.last_progress_ts = now
         self.replica_tokens[assign.replica.name] = \
@@ -996,6 +1068,7 @@ class Router:
                                                    verify_bundle)
         src = active.replica
         req.handoff_tokens = len(req.tokens_out)
+        h0 = time.monotonic()      # handoff span clock — tracer-aligned
         # fault hook: handoff_torn corrupts the bundle in transit,
         # handoff_stall loses it outright — both land in the fallback
         torn = stalled = False
@@ -1089,6 +1162,16 @@ class Router:
             _registry.counter(
                 "handoff/skipped",
                 help="promotions with no cached pages to ship").inc()
+        if fault_kind is not None:
+            telemetry.reqtrace.flag(req.trace, "reprefill")
+        telemetry.reqtrace.complete(
+            "router/handoff", req.trace, h0, time.monotonic(),
+            tid=req.uid, src=src.name,
+            dst=(dec.name if dec is not None else None),
+            pages=adopted,
+            bytes=(bundle.nbytes if adopted and bundle is not None
+                   else 0),
+            fault=fault_kind)
         try:
             self._dispatch(req, prefer=dec)
         except AdmissionError:
@@ -1103,6 +1186,26 @@ class Router:
                      else RequestState.FINISHED)
         req.finish_reason = reason
         req.finish_ts = self.clock()
+        if req.trace is None:
+            return
+        # the router owns the root context: emit the client-visible
+        # envelope span, then hand the trace to the tail sampler —
+        # retained (flushed into the ring) or dropped whole
+        rt = telemetry.reqtrace
+        ttft = (req.first_token_ts - req.submit_ts
+                if req.first_token_ts is not None
+                and req.submit_ts is not None else None)
+        tpot = ((req.finish_ts - req.first_token_ts) /
+                (len(req.tokens_out) - 1)
+                if req.first_token_ts is not None
+                and len(req.tokens_out) >= 2 else None)
+        if req.submit_ts is not None:
+            rt.complete("router/request", req.trace, req.submit_ts,
+                        req.finish_ts, tid=req.uid, envelope=True,
+                        reason=reason, tokens_out=len(req.tokens_out),
+                        failovers=req.failovers, hedged=int(req.hedged),
+                        handoff_tokens=req.handoff_tokens)
+        rt.finish(req.trace, reason=reason, ttft_s=ttft, tpot_s=tpot)
 
     # -- draining & recovery ledger -----------------------------------------
 

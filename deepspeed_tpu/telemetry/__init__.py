@@ -77,6 +77,9 @@ from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               Histogram, MetricsRegistry,
                                               registry)
+from deepspeed_tpu.telemetry.reqtrace import (ReqTrace,  # noqa: F401
+                                              TraceContext, critical_path,
+                                              reqtrace)
 from deepspeed_tpu.telemetry.slo import (Objective, SLOEngine,  # noqa: F401
                                          engine_from_config,
                                          evaluate_history)
@@ -101,16 +104,27 @@ __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "publish_gauges", "render", "resolve_peaks", "MetricsServer",
            "MetricHistory", "load_records", "merge_records",
            "resolve_metric", "windowed", "Objective", "SLOEngine",
-           "engine_from_config", "evaluate_history"]
+           "engine_from_config", "evaluate_history", "reqtrace",
+           "ReqTrace", "TraceContext", "critical_path"]
 
 
 def configure(telemetry_config) -> None:
     """Apply a :class:`~deepspeed_tpu.config.config.TelemetryConfig` to
     the process-wide tracer. Enable-only: an engine whose config leaves
     telemetry off must not silence a tracer something else (bench
-    ``--trace``, a test) already turned on."""
-    if telemetry_config is None or \
-            not getattr(telemetry_config, "enabled", False):
+    ``--trace``, a test) already turned on. The ``reqtrace`` sub-block
+    additionally arms request-scoped tracing (its own ``enabled`` gate,
+    independent of the span tracer's)."""
+    if telemetry_config is None:
+        return
+    rt = getattr(telemetry_config, "reqtrace", None)
+    if rt is not None and getattr(rt, "enabled", False):
+        reqtrace.configure(
+            enabled=True,
+            head_sample=getattr(rt, "head_sample", None),
+            retain_slow_ms=getattr(rt, "retain_slow_ms", None),
+            buffer_traces=getattr(rt, "buffer_traces", None))
+    if not getattr(telemetry_config, "enabled", False):
         return
     tracer.configure(
         enabled=True,

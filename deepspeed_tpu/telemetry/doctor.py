@@ -213,6 +213,29 @@ def analyze(dumps: List[Dict[str, Any]],
     slo_open = [e for e in latest_slo.values()
                 if e.get("kind") == "slo_breach"]
 
+    # -- slow requests: tail-retained request traces from each host's
+    # reqtrace black-box section, worst total first, each with its
+    # critical-path dominant segment (the acceptance question "where did
+    # this slow request's time go" answered without opening Perfetto)
+    slow_requests = []
+    trace_drops = {"dropped_ok": 0.0, "ring_dropped": 0.0, "pending": 0}
+    for i, doc in enumerate(dumps):
+        rq = doc.get("reqtrace") or {}
+        trace_drops["dropped_ok"] += float(rq.get("dropped_ok") or 0)
+        trace_drops["ring_dropped"] += float(rq.get("ring_dropped") or 0)
+        trace_drops["pending"] += int(rq.get("pending") or 0)
+        for s in rq.get("retained", []):
+            row = {**s, "host": _host_name(doc, i)}
+            bd = dict(s.get("breakdown_ms") or {})
+            if bd:
+                dom = max(bd, key=bd.get)
+                total = s.get("total_ms") or sum(bd.values()) or 1.0
+                row["dominant"] = dom
+                row["dominant_pct"] = \
+                    100.0 * bd[dom] / max(total, 1e-9)
+            slow_requests.append(row)
+    slow_requests.sort(key=lambda r: -(r.get("total_ms") or 0.0))
+
     # -- anomaly timeline across hosts
     timeline = []
     for i, doc in enumerate(dumps):
@@ -293,6 +316,7 @@ def analyze(dumps: List[Dict[str, Any]],
             "storms": storms, "world": world, "verdict": verdict,
             "slo": {"timeline": slo_timeline, "open": slo_open},
             "recovery_timeline": recovery_timeline,
+            "reqtrace": {"slow_requests": slow_requests, **trace_drops},
             "crash_looping": crash_looping, "draining": draining,
             "resilience": {"faults_injected": n_faults,
                            "recoveries": n_recoveries,
@@ -414,6 +438,38 @@ def render(report: Dict[str, Any]) -> str:
                    if d.get("replica") else f"{d['host']}")
             out.append(f"  draining: {who} (intentional scale-down in "
                        f"flight — not a crash loop)")
+    rq = report.get("reqtrace") or {}
+    if rq.get("slow_requests") or rq.get("dropped_ok") \
+            or rq.get("ring_dropped"):
+        out.append("")
+        out.append(f"slow requests ({len(rq.get('slow_requests') or [])} "
+                   f"tail-retained, {int(rq.get('dropped_ok') or 0)} "
+                   f"dropped ok, {int(rq.get('ring_dropped') or 0)} "
+                   f"ring-dropped spans, {int(rq.get('pending') or 0)} "
+                   f"undecided):")
+        for r in (rq.get("slow_requests") or [])[:20]:
+            ttft = r.get("ttft_ms")
+            dom = (f"{r['dominant']} "
+                   f"{r.get('dominant_pct', 0.0):.0f}%"
+                   if r.get("dominant") else "?")
+            out.append(
+                f"  {r.get('trace_id', '?'):<18}{r['host']:<20}"
+                f"reason={r.get('reason')!s:<10}"
+                f"ttft={'-' if ttft is None else f'{ttft:.0f}ms':<9}"
+                f"total={r.get('total_ms') or 0.0:.0f}ms  "
+                f"dominant: {dom}  "
+                f"[{','.join(r.get('causes') or [])}]")
+            bd = r.get("breakdown_ms") or {}
+            total = r.get("total_ms") or sum(bd.values()) or 1.0
+            if bd:
+                out.append("      " + " | ".join(
+                    f"{seg} {ms:.0f}ms ({100.0 * ms / total:.0f}%)"
+                    for seg, ms in sorted(bd.items(),
+                                          key=lambda kv: -kv[1])))
+            out.append(f"      replay with: dstpu-trace --request "
+                       f"{r.get('trace_id', '?')} <dump dir>")
+        if len(rq.get("slow_requests") or []) > 20:
+            out.append(f"  ... {len(rq['slow_requests']) - 20} more")
     out.append("")
     return "\n".join(out)
 

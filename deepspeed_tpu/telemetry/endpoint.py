@@ -83,7 +83,17 @@ class MetricsServer:
     def _metrics(self):
         try:
             from deepspeed_tpu.telemetry import metrics_text
-            return 200, "text/plain; version=0.0.4", metrics_text()
+            body = metrics_text()
+            # histogram buckets may carry OpenMetrics exemplar suffixes
+            # (`# {trace_id="..."} value`, from request tracing) —
+            # advertise the OpenMetrics content type when they do, so
+            # exemplar-aware scrapers ingest them; plain Prometheus
+            # parsers read the same body either way (dstpu-top's parser
+            # strips the suffix)
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8" if " # {" in body
+                     else "text/plain; version=0.0.4")
+            return 200, ctype, body
         except Exception as e:                       # noqa: BLE001
             return 500, "text/plain", f"metrics error: {e}\n"
 

@@ -68,16 +68,31 @@ _ROUTER_STATE_RE = re.compile(r"^router_replica_(.+)_state$")
 _AUTOSCALE_RE = re.compile(r"^autoscale_(target|replicas)_(.+)$")
 
 
+_EXEMPLAR_RE = re.compile(
+    r'\s#\s\{trace_id="([^"]*)"\}\s+(\S+)\s*$')
+
+
 def parse_prometheus_text(text: str) -> Dict[str, Any]:
     """Prometheus text exposition → ``{flat_name: float}`` for scalars
     plus ``{name: {"buckets": [(le, cum), ...], "sum": s, "count": n}}``
-    for histograms. Tolerates unknown lines (forward compatible)."""
+    for histograms. OpenMetrics exemplar suffixes on bucket lines
+    (``... # {trace_id="..."} value``) are captured into the
+    histogram's ``"exemplars"`` list as ``{"le", "trace_id", "value"}``
+    dicts. Tolerates unknown lines (forward compatible)."""
     out: Dict[str, Any] = {}
     hists: Dict[str, Dict[str, Any]] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        exemplar = None
+        m = _EXEMPLAR_RE.search(line)
+        if m:
+            line = line[:m.start()].rstrip()
+            try:
+                exemplar = (m.group(1), float(m.group(2)))
+            except ValueError:
+                exemplar = (m.group(1), None)
         try:
             key, val = line.rsplit(None, 1)
             fval = float(val)
@@ -87,8 +102,12 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
             name, le = key[:-2].split('_bucket{le="', 1)
             h = hists.setdefault(name, {"buckets": [], "sum": 0.0,
                                         "count": 0.0})
-            h["buckets"].append((float("inf") if le == "+Inf"
-                                 else float(le), fval))
+            le_f = float("inf") if le == "+Inf" else float(le)
+            h["buckets"].append((le_f, fval))
+            if exemplar is not None:
+                h.setdefault("exemplars", []).append(
+                    {"le": le_f, "trace_id": exemplar[0],
+                     "value": exemplar[1]})
         elif key.endswith("_sum") and key[:-4] in hists:
             hists[key[:-4]]["sum"] = fval
         elif key.endswith("_count") and key[:-6] in hists:
@@ -97,6 +116,17 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
             out[key] = fval
     out.update(hists)
     return out
+
+
+def worst_exemplar(h: Any) -> Optional[Dict[str, Any]]:
+    """The highest-bucket exemplar of a parsed histogram — the trace_id
+    to feed ``dstpu-trace --request`` for this histogram's tail."""
+    if not isinstance(h, dict):
+        return None
+    exs = h.get("exemplars") or []
+    if not exs:
+        return None
+    return max(exs, key=lambda e: e.get("le", 0.0))
 
 
 def hist_percentile(h: Dict[str, Any], p: float,
@@ -183,6 +213,7 @@ class HostSample:
             "router": router_states(m),
             "autoscale": autoscale_targets(m),
             "kvtier": kvtier_state(m),
+            "exemplars": latency_exemplars(m),
         }
 
 
@@ -216,6 +247,22 @@ def kvtier_state(metrics: Dict[str, Any]) -> Optional[Dict[str, float]]:
         v = metrics.get(name)
         if isinstance(v, (int, float)):
             out[short] = float(v)
+    return out or None
+
+
+def latency_exemplars(metrics: Dict[str, Any]
+                      ) -> Optional[Dict[str, Dict[str, Any]]]:
+    """Worst-bucket latency exemplars from a host's parsed exposition —
+    the trace_ids an operator feeds ``dstpu-trace --request`` to see
+    exactly where the tail request's time went. None when the host
+    exposes no exemplars (request tracing off)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for short, name in (("ttft", "serving_ttft_seconds"),
+                        ("tpot", "serving_tpot_seconds"),
+                        ("router_ttft", "router_ttft_seconds")):
+        ex = worst_exemplar(metrics.get(name))
+        if ex is not None:
+            out[short] = ex
     return out or None
 
 
@@ -375,6 +422,13 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
             pairs = " ".join(f"{k}={v:g}"
                              for k, v in r["kvtier"].items())
             lines.append(f"    └─ kvtier: {pairs}")
+        if r.get("exemplars"):
+            pairs = " ".join(
+                f"{k}={e.get('trace_id')}"
+                + (f"@{e['value'] * 1e3:.0f}ms"
+                   if isinstance(e.get("value"), (int, float)) else "")
+                for k, e in r["exemplars"].items())
+            lines.append(f"    └─ tail exemplars: {pairs}")
     degraded = sum(1 for r in rows if r["status"] not in ("ok",))
     lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
                  f"(* = interval percentile, ms)")

@@ -203,6 +203,14 @@ class FlightRecorder:
         except Exception:
             pass
         try:
+            # tail-retained request-trace summaries + drop accounting
+            # (dstpu-doctor's "slow requests" section reads this)
+            from deepspeed_tpu.telemetry.reqtrace import reqtrace
+            if reqtrace.enabled:
+                doc["reqtrace"] = reqtrace.post_mortem()
+        except Exception:
+            pass
+        try:
             from deepspeed_tpu.telemetry.compile_monitor import \
                 compile_monitor
             doc["compile"] = compile_monitor.summary()

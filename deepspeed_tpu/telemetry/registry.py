@@ -131,6 +131,12 @@ class Histogram:
     geometric ladder's float rounding used to leave it a hair above or
     below, sending boundary values to the wrong side). ``vmin``/``vmax``
     track exact extremes regardless of bucketing.
+
+    Each bucket additionally carries one OpenMetrics *exemplar* slot
+    (trace_id + exact value, latest sample wins): ``record(v,
+    exemplar=trace_id)`` links the bucket to the request trace that
+    landed in it, so a bad p99 bucket on a dashboard resolves to an
+    openable trace instead of an anonymous count.
     """
 
     def __init__(self, lo: float = 1e-4, hi: float = 100.0,
@@ -147,17 +153,31 @@ class Histogram:
         self.total = 0.0
         self.vmin: Optional[float] = None
         self.vmax: Optional[float] = None
+        #: bucket index → (trace_id, exact value); index ``n_buckets`` is
+        #: the overflow (+Inf) bucket's slot
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
         self._lock = threading.Lock()
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, exemplar: Optional[str] = None) -> None:
         if not math.isfinite(v):
             return
         with self._lock:
-            self.counts[bisect.bisect_left(self.bounds, v)] += 1
+            i = bisect.bisect_left(self.bounds, v)
+            self.counts[i] += 1
             self.count += 1
             self.total += v
             self.vmin = v if self.vmin is None else min(self.vmin, v)
             self.vmax = v if self.vmax is None else max(self.vmax, v)
+            if exemplar:
+                self.exemplars[i] = (str(exemplar), float(v))
+
+    def worst_exemplar(self) -> Optional[Tuple[str, float]]:
+        """The exemplar in the highest occupied bucket that has one —
+        the trace to open for this histogram's tail."""
+        with self._lock:
+            for i in sorted(self.exemplars, reverse=True):
+                return self.exemplars[i]
+        return None
 
     @property
     def mean(self) -> float:
@@ -261,7 +281,10 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition (v0.0.4) of every registered metric.
         Histogram buckets are rendered cumulatively with an explicit
-        ``+Inf`` bucket, per the format spec."""
+        ``+Inf`` bucket, per the format spec. Buckets holding an exemplar
+        append it OpenMetrics-style — ``... 5 # {trace_id="..."} 0.67`` —
+        which exposition parsers must strip from the sample line (the
+        fleet poller's does)."""
         with self._lock:
             items = list(self._metrics.items())
             helps = dict(self._help)
@@ -278,13 +301,22 @@ class MetricsRegistry:
                 lines.append(f"{pn} {_fmt(m.value)}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {pn} histogram")
+                with m._lock:
+                    exemplars = dict(m.exemplars)
                 acc = 0
-                for bound, c in zip(m.bounds, m.counts):
+                for i, (bound, c) in enumerate(zip(m.bounds, m.counts)):
                     acc += c
-                    lines.append(
-                        f'{pn}_bucket{{le="{_fmt(bound)}"}} {acc}')
+                    line = f'{pn}_bucket{{le="{_fmt(bound)}"}} {acc}'
+                    if i in exemplars:
+                        tid, ev = exemplars[i]
+                        line += f' # {{trace_id="{tid}"}} {_fmt(ev)}'
+                    lines.append(line)
                 acc += m.counts[-1]
-                lines.append(f'{pn}_bucket{{le="+Inf"}} {acc}')
+                line = f'{pn}_bucket{{le="+Inf"}} {acc}'
+                if len(m.bounds) in exemplars:
+                    tid, ev = exemplars[len(m.bounds)]
+                    line += f' # {{trace_id="{tid}"}} {_fmt(ev)}'
+                lines.append(line)
                 lines.append(f"{pn}_sum {_fmt(m.total)}")
                 lines.append(f"{pn}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")

@@ -8,13 +8,25 @@ and SELF time — total minus time spent in nested child spans on the same
 thread. Self time is the number that answers "where did step time go":
 a ``train/step`` span that is 95% covered by its forward/backward/
 optimizer children has ~5% self time (host-side glue).
+
+``dstpu-trace --request <trace_id> dump1.json hostB/`` is the
+post-mortem assembler for request-scoped distributed traces
+(:mod:`~deepspeed_tpu.telemetry.reqtrace`): it merges any number of
+per-host dumps (files or directories of ``*.json``), keeps only the
+spans stamped with that ``trace_id``, synthesizes Chrome flow events
+from the ``parent_span_id`` → ``span_id`` edges so Perfetto draws the
+cross-process arrows (router → prefill replica → handoff → decode
+replica), verifies the parent/child chain is unbroken, and prints the
+critical-path breakdown (queued / prefill / handoff / decode / replayed
+/ stalled, with % of total). ``--out`` writes the merged trace JSON.
 """
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
-from typing import Any, Dict, Iterable, List
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 
 def load_trace(path: str) -> List[Dict[str, Any]]:
@@ -29,6 +41,132 @@ def load_trace(path: str) -> List[Dict[str, Any]]:
     else:
         raise ValueError(f"{path}: not a Chrome trace (got {type(data)})")
     return [e for e in events if isinstance(e, dict)]
+
+
+def expand_paths(paths: Iterable[str]) -> List[str]:
+    """Files stay files; directories expand to their sorted ``*.json``
+    entries (the multi-host dump layout: one trace dump per host)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".json")))
+        else:
+            out.append(p)
+    return out
+
+
+def load_merged(paths: Iterable[str]
+                ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Merge events from many dumps into one timeline. Each source
+    file's pids are remapped to a unique range (two hosts both dumping
+    pid 1234 must not share a Perfetto process track) and a
+    ``process_name`` metadata event names each track after its source.
+    Returns ``(events, metadata_events)``."""
+    events: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+    pid_map: Dict[Tuple[int, Any], int] = {}
+    for i, path in enumerate(expand_paths(paths)):
+        for e in load_trace(path):
+            e = dict(e)
+            key = (i, e.get("pid", 0))
+            newpid = pid_map.get(key)
+            if newpid is None:
+                newpid = pid_map[key] = len(pid_map) + 1
+                meta.append({"ph": "M", "name": "process_name",
+                             "pid": newpid, "tid": 0,
+                             "args": {"name": f"{os.path.basename(path)}"
+                                              f":{e.get('pid', 0)}"}})
+            e["pid"] = newpid
+            events.append(e)
+    return events, meta
+
+
+def request_events(events: Iterable[Dict[str, Any]],
+                   trace_id: str) -> List[Dict[str, Any]]:
+    """The subset of ``events`` stamped with ``trace_id``."""
+    return [e for e in events
+            if isinstance(e.get("args"), dict)
+            and e["args"].get("trace_id") == trace_id]
+
+
+def flow_events(events: List[Dict[str, Any]]
+                ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Synthesize Chrome flow events ('s'/'f' pairs) from the
+    ``parent_span_id`` → ``span_id`` edges of one request's span set, so
+    Perfetto draws the cross-process arrows. Returns ``(flows,
+    orphan_parent_ids)`` — a non-empty orphan list means the
+    parent/child chain is broken (a leg's dump is missing)."""
+    spans = [e for e in events if e.get("ph") == "X"
+             and isinstance(e.get("args"), dict)]
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for e in spans:
+        sid = e["args"].get("span_id")
+        if sid:
+            by_id.setdefault(sid, e)
+    flows: List[Dict[str, Any]] = []
+    orphans: List[str] = []
+    for e in spans:
+        pid_ = e["args"].get("parent_span_id")
+        sid = e["args"].get("span_id")
+        if not pid_:
+            continue
+        parent = by_id.get(pid_)
+        if parent is None:
+            orphans.append(pid_)
+            continue
+        if parent is e:
+            continue
+        fid = f"req-{sid}"
+        common = {"cat": "reqflow", "name": "request", "id": fid}
+        flows.append({**common, "ph": "s", "ts": float(parent["ts"]),
+                      "pid": parent.get("pid", 0),
+                      "tid": parent.get("tid", 0)})
+        flows.append({**common, "ph": "f", "bp": "e",
+                      "ts": float(e["ts"]), "pid": e.get("pid", 0),
+                      "tid": e.get("tid", 0)})
+    return flows, sorted(set(orphans))
+
+
+def format_critical_path(breakdown: Dict[str, float]) -> str:
+    """Render a :func:`~deepspeed_tpu.telemetry.reqtrace.critical_path`
+    attribution as aligned ``segment  ms  %`` lines."""
+    total = breakdown.get("_total_ms", 0.0) or 1.0
+    lines = [f"{'segment':<12}{'ms':>10}{'% of total':>12}"]
+    segs = [(k, v) for k, v in breakdown.items() if k != "_total_ms"]
+    for seg, ms in sorted(segs, key=lambda kv: -kv[1]):
+        lines.append(f"{seg:<12}{ms:>10.2f}{100.0 * ms / total:>11.1f}%")
+    lines.append(f"{'total':<12}{total:>10.2f}{100.0:>11.1f}%")
+    return "\n".join(lines)
+
+
+def assemble_request(paths: Iterable[str], trace_id: str,
+                     out: Optional[str] = None) -> Dict[str, Any]:
+    """``--request`` mode: merge dumps, filter to one trace, add flow
+    events, optionally write the merged trace JSON. Returns a report
+    dict (events, flows, orphans, breakdown, by_process)."""
+    from deepspeed_tpu.telemetry.reqtrace import critical_path
+    merged, meta = load_merged(paths)
+    evs = request_events(merged, trace_id)
+    flows, orphans = flow_events(evs)
+    doc = {"traceEvents": sorted(evs + flows + meta,
+                                 key=lambda e: float(e.get("ts", 0.0))),
+           "displayTimeUnit": "ms",
+           "otherData": {"tracer": "deepspeed_tpu.telemetry",
+                         "request": trace_id}}
+    if out and evs:
+        parent = os.path.dirname(os.path.abspath(out))
+        os.makedirs(parent, exist_ok=True)
+        with open(out, "w") as fh:
+            json.dump(doc, fh)
+    by_process: Dict[Any, int] = defaultdict(int)
+    for e in evs:
+        by_process[e.get("pid", 0)] += 1
+    return {"trace_id": trace_id, "events": evs, "flows": flows,
+            "orphans": orphans, "doc": doc,
+            "breakdown": critical_path(evs),
+            "by_process": dict(by_process)}
 
 
 def self_times(events: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
@@ -101,14 +239,49 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dstpu-trace",
         description="Per-span self-time breakdown of a deepspeed_tpu "
-                    "Chrome trace-event JSON dump")
-    ap.add_argument("trace", help="trace file (tracer.dump output)")
+                    "Chrome trace-event JSON dump; --request assembles "
+                    "one request's distributed trace from multi-host "
+                    "dumps")
+    ap.add_argument("trace", nargs="+",
+                    help="trace file(s) or directories of dumps "
+                         "(tracer.dump output)")
     ap.add_argument("--sort", choices=("self", "total", "count"),
                     default="self", help="sort column (default: self)")
     ap.add_argument("--top", type=int, default=0,
                     help="show only the top N spans (0 = all)")
+    ap.add_argument("--request", metavar="TRACE_ID", default=None,
+                    help="assemble the distributed trace of one request "
+                         "across all given dumps (merged Perfetto trace "
+                         "with flow events + critical-path breakdown)")
+    ap.add_argument("--out", default=None,
+                    help="with --request: write the merged trace JSON "
+                         "here (load in ui.perfetto.dev)")
     args = ap.parse_args(argv)
-    events = load_trace(args.trace)
+    if args.request:
+        rep = assemble_request(args.trace, args.request, out=args.out)
+        if not rep["events"]:
+            print(f"trace_id {args.request}: no spans found in "
+                  f"{len(expand_paths(args.trace))} dump(s) — was the "
+                  f"trace retained? (tail sampling drops fast, "
+                  f"unflagged requests)", file=sys.stderr)
+            return 1
+        n_proc = len(rep["by_process"])
+        print(f"request {args.request}: {len(rep['events'])} spans "
+              f"across {n_proc} process(es), "
+              f"{len(rep['flows']) // 2} flow edges")
+        if rep["orphans"]:
+            print(f"WARNING: broken parent/child chain — "
+                  f"{len(rep['orphans'])} parent span(s) missing "
+                  f"({', '.join(rep['orphans'][:4])}) — a leg's dump "
+                  f"was not provided", file=sys.stderr)
+        print()
+        print(format_critical_path(rep["breakdown"]))
+        if args.out:
+            print(f"\nmerged trace written to {args.out}")
+        return 0
+    events: List[Dict[str, Any]] = []
+    for path in expand_paths(args.trace):
+        events.extend(load_trace(path))
     print(format_table(self_times(events), sort=args.sort, top=args.top))
     n_instant = sum(1 for e in events if e.get("ph") == "i")
     if n_instant:

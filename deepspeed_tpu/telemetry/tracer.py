@@ -69,7 +69,27 @@ class Tracer:
         with self._lock:
             if len(self._buf) == self._buf.maxlen:
                 self._dropped += 1
+                dropped = True
+            else:
+                dropped = False
             self._buf.append(ev)
+        if dropped:
+            # ring wrap is data loss for the post-mortem — announce it
+            # (dstpu-doctor reads trace/ring_dropped from the black box)
+            try:
+                from deepspeed_tpu.telemetry.registry import registry
+                registry.counter(
+                    "trace/ring_dropped",
+                    help="span events evicted by tracer ring wrap").inc()
+            except Exception:                            # noqa: BLE001
+                pass
+
+    def ingest(self, events: List[Dict[str, Any]]) -> None:
+        """Append pre-formed trace-event dicts (the tail-sampler's flush
+        path: a retained request's buffered spans enter the ring here).
+        Ring bounds and drop accounting apply as for live spans."""
+        for ev in events:
+            self._append(ev)
 
     def _event(self, name: str, ph: str, ts_us: float,
                tid: Optional[int], args: Dict[str, Any]) -> Dict[str, Any]:
@@ -97,10 +117,13 @@ class Tracer:
             return None
 
     @contextmanager
-    def span(self, name: str, step: Optional[int] = None, **args):
+    def span(self, name: str, step: Optional[int] = None, ctx=None, **args):
         """Record the enclosed block as a complete span. Nestable; nesting
         is reconstructed from ts/dur containment (same pid/tid), which is
-        how Chrome/Perfetto render the flame graph."""
+        how Chrome/Perfetto render the flame graph. ``ctx`` (a
+        :class:`~deepspeed_tpu.telemetry.reqtrace.TraceContext`) stamps
+        the span with trace_id/span_id/parent_span_id args so it joins a
+        request-scoped distributed trace."""
         if not self.enabled:
             yield
             return
@@ -116,27 +139,34 @@ class Tracer:
                 ann.__exit__(None, None, None)
             if step is not None:
                 args = {**args, "step": step}
+            if ctx is not None:
+                args = {**ctx.tags(), **args}
             ev = self._event(name, "X", (t0 - self._t0) * 1e6, None, args)
             ev["dur"] = (t1 - t0) * 1e6
             self._append(ev)
 
-    def instant(self, name: str, tid: Optional[int] = None, **args) -> None:
+    def instant(self, name: str, tid: Optional[int] = None, ctx=None,
+                **args) -> None:
         """Record a zero-duration marker (ph='i', thread-scoped)."""
         if not self.enabled:
             return
+        if ctx is not None:
+            args = {**ctx.tags(), **args}
         ev = self._event(name, "i",
                          (time.perf_counter() - self._t0) * 1e6, tid, args)
         ev["s"] = "t"
         self._append(ev)
 
     def complete(self, name: str, start: float, end: float,
-                 tid: Optional[int] = None, **args) -> None:
+                 tid: Optional[int] = None, ctx=None, **args) -> None:
         """Record a span retroactively from ``start``/``end`` timestamps in
         seconds on the tracer's clock (or any CLOCK_MONOTONIC-derived clock
         — ``time.monotonic`` stamps from the serving frontend align on
         Linux). Used for lifecycles that cross call boundaries."""
         if not self.enabled:
             return
+        if ctx is not None:
+            args = {**ctx.tags(), **args}
         ev = self._event(name, "X", (start - self._t0) * 1e6, tid, args)
         ev["dur"] = max(0.0, (end - start) * 1e6)
         self._append(ev)

@@ -24,6 +24,12 @@ tuples the FaultInjector validates plans against) must be documented in
 ``docs/resilience.md`` — an undocumented kind is a chaos drill nobody
 can discover or interpret from the runbook.
 
+And the SPAN CATALOG: every literal span name the serving tier
+(``deepspeed_tpu/serving``: frontend, router, handoff, kvtier) emits via
+``span``/``instant``/``complete`` must appear in
+``docs/observability.md`` — request-scoped traces are only as readable
+as their span names are documented.
+
 Usage: ``python tools/check_metric_names.py [root]`` → exit 0 clean,
 exit 1 with one line per violation. Invoked from the tier-1 suite
 (tests/test_diagnostics.py) so a bad name fails CI.
@@ -43,7 +49,11 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
                "fleet", "handoff", "kvtier", "mem", "overlap",
                "resilience", "roofline", "router", "serving", "slo",
-               "train", "tune")
+               "trace", "train", "tune")
+
+#: span-emitting methods (Tracer / ReqTrace) linted by the span-catalog
+#: check below
+SPAN_METHODS = ("span", "instant", "complete")
 
 
 def _literal_name(node: ast.AST) -> Optional[str]:
@@ -163,6 +173,66 @@ def check_fault_kinds(pkg_root: str) -> List[str]:
             for k in kinds if k not in doc]
 
 
+def collect_span_names(pkg_root: str) -> List[Tuple[str, int, str]]:
+    """(file, line, span_name) for every literal-name ``span`` /
+    ``instant`` / ``complete`` call site under the serving tier
+    (``deepspeed_tpu/serving``: frontend, router, handoff, kvtier) —
+    the spans that appear in request-scoped distributed traces."""
+    sites: List[Tuple[str, int, str]] = []
+    root = os.path.join(pkg_root, "serving")
+    if not os.path.isdir(root):
+        return sites
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue                  # reported by collect_sites
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in SPAN_METHODS and node.args):
+                    continue
+                name = _literal_name(node.args[0])
+                if name is None or "{}" in name or "/" not in name:
+                    continue
+                sites.append((os.path.relpath(path, pkg_root),
+                              node.lineno, name))
+    return sites
+
+
+def check_span_names(pkg_root: str) -> List[str]:
+    """Every span name the serving tier emits must appear in
+    docs/observability.md (the span catalog) — mirrors the fault-kind
+    check: an undocumented span is a trace nobody can interpret."""
+    sites = collect_span_names(pkg_root)
+    if not sites:
+        return []
+    doc_path = os.path.join(os.path.dirname(pkg_root), "docs",
+                            "observability.md")
+    if not os.path.exists(doc_path):
+        return [f"docs/observability.md missing but the serving tier "
+                f"emits {len(sites)} literal-name spans"]
+    with open(doc_path, encoding="utf-8") as fh:
+        doc = fh.read()
+    errors = []
+    seen: Set[str] = set()
+    for path, line, name in sites:
+        if name in doc or name in seen:
+            continue
+        seen.add(name)
+        errors.append(f"{path}:{line}: span {name!r} emitted by the "
+                      f"serving tier but docs/observability.md never "
+                      f"mentions it (add it to the span catalog)")
+    return errors
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
@@ -171,11 +241,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     sites = collect_sites(root)
     errors = check(sites)
     errors += check_fault_kinds(root)
+    errors += check_span_names(root)
     for e in errors:
         print(e)
     if not errors:
+        spans = {name for _, _, name in collect_span_names(root)}
         print(f"check_metric_names: {len(sites)} literal call sites OK; "
-              f"{len(collect_fault_kinds(root))} fault kinds documented")
+              f"{len(collect_fault_kinds(root))} fault kinds documented; "
+              f"{len(spans)} span names documented")
     return 1 if errors else 0
 
 
