@@ -94,6 +94,24 @@ def _trace_exemplars_extra() -> dict:
         return {}
 
 
+def _goodput_extra() -> dict:
+    """Final goodput-ledger sweep → the BENCH ``extra.goodput`` stamp
+    (uptime attribution + dominant badput). {} when the ledger is off or
+    on any failure; never breaks the headline JSON."""
+    try:
+        from deepspeed_tpu.telemetry.goodput import goodput_ledger
+        if not goodput_ledger.enabled:
+            return {}
+        goodput_ledger.update()
+        s = goodput_ledger.summary() or {}
+        return {k: s.get(k) for k in
+                ("uptime_s", "goodput_s", "fraction", "window_fraction",
+                 "badput", "dominant_badput", "dominant_badput_s",
+                 "captures")} if s else {}
+    except Exception:                                # noqa: BLE001
+        return {}
+
+
 def bench_shared_prefix(args) -> None:
     """serving-frontend scenario: a stream of prompts sharing a 50%
     prefix (system prompt / few-shot preamble), served through
@@ -482,6 +500,10 @@ def bench_diurnal(args) -> None:
     on_tpu = jax.devices()[0].platform == "tpu"
     size = args.size or ("1b" if on_tpu else "tiny")
     ds.build_mesh(data=1, devices=jax.devices()[:1])
+    # goodput ledger over the drill: serving/engine_step spans attribute
+    # token work vs idle; the stamp lands in extra.goodput below
+    telemetry.tracer.configure(enabled=True)
+    telemetry.goodput_ledger.configure(enabled=True)
     seq_cap = 256
     model = llama3_config(size, max_seq_len=seq_cap, tie_embeddings=True)
     dtype = "bfloat16" if on_tpu else "float32"
@@ -712,6 +734,7 @@ def bench_diurnal(args) -> None:
                        "balanced": faults == recoveries},
             "slo": _slo_extra(),
             "trace_exemplars": _trace_exemplars_extra(),
+            "goodput": _goodput_extra(),
         },
     }
     if tune_extra is not None:

@@ -36,6 +36,7 @@ Layout (v2, multi-host)::
     <dir>/latest                   # newest committed tag (written by p0)
 """
 
+import functools
 import json
 import os
 import shutil
@@ -171,6 +172,27 @@ def _agree_ok(ok: bool) -> bool:
     return bool(np.all(flags))
 
 
+def _traced(name: str):
+    """Wrap a store entry point in a retroactive tracer span (``ph="X"``
+    via :meth:`Tracer.complete`) so the goodput ledger can attribute
+    checkpoint wall time to its ``ckpt`` category. No-op overhead when
+    the tracer is disabled; for async saves only the synchronous
+    device→host snapshot portion lands in the span — the background
+    commit is overlapped with training and is not badput."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from deepspeed_tpu.telemetry.tracer import tracer
+            t0 = tracer.now()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                tracer.complete(name, t0, tracer.now())
+        return wrapper
+    return deco
+
+
+@_traced("checkpoint/save")
 def save_checkpoint(save_dir: str, tag: str, state: Dict[str, Pytree],
                     meta: Dict[str, Any], save_latest: bool = True,
                     async_save: bool = False):
@@ -516,6 +538,7 @@ def _candidate_tags(load_dir: str, exclude=()) -> List[str]:
     return [name for _, name in sorted(out, reverse=True)]
 
 
+@_traced("checkpoint/restore")
 def load_checkpoint(load_dir: str, tag: Optional[str],
                     templates: Dict[str, Pytree],
                     shardings: Dict[str, Pytree],

@@ -111,6 +111,36 @@ def barrier() -> None:
 AxisName = Union[str, Sequence[str]]
 
 
+def _timed(op: str, x: jax.Array, axis: AxisName, run) -> jax.Array:
+    """Register the collective with the CommsLogger, and — on the
+    synchronous path in verbose mode — record its MEASURED wall time so
+    the goodput ledger's ``comm_exposed`` attribution has a ground-truth
+    cross-check against the roofline estimate. Inside shard_map/pmap
+    ``x`` is an abstract tracer: timing a trace-time call would clock
+    XLA's lowering, not the collective, so those register untimed (the
+    roofline remains the estimate there). The timed path blocks on the
+    result, which the synchronous eager semantics already imply."""
+    try:
+        size = x.size * x.dtype.itemsize
+    except Exception:
+        size = 0
+    if not (comms_logger.verbose and comms_logger.should_log(op)) \
+            or isinstance(x, jax.core.Tracer):
+        comms_logger.append(op, size, axis)
+        return run()
+    from deepspeed_tpu.telemetry.tracer import tracer
+    t0 = tracer.now()
+    try:
+        out = jax.block_until_ready(run())
+    except Exception:
+        comms_logger.append(op, size, axis)
+        raise
+    t1 = tracer.now()
+    comms_logger.append(op, size, axis, time_sec=t1 - t0)
+    tracer.complete(f"comm/{op}", t0, t1, bytes=size)
+    return out
+
+
 def _log(op: str, x: jax.Array, axis: AxisName) -> None:
     try:
         size = x.size * x.dtype.itemsize
@@ -121,47 +151,54 @@ def _log(op: str, x: jax.Array, axis: AxisName) -> None:
 
 def all_reduce(x: jax.Array, axis_name: AxisName, op: str = "sum") -> jax.Array:
     """Reference comm.py:641 (all_reduce). Inside shard_map/pmap only."""
-    _log("all_reduce", x, axis_name)
     if op == "sum":
-        return lax.psum(x, axis_name)
+        return _timed("all_reduce", x, axis_name,
+                      lambda: lax.psum(x, axis_name))
     if op == "mean":
-        return lax.pmean(x, axis_name)
+        return _timed("all_reduce", x, axis_name,
+                      lambda: lax.pmean(x, axis_name))
     if op == "max":
-        return lax.pmax(x, axis_name)
+        return _timed("all_reduce", x, axis_name,
+                      lambda: lax.pmax(x, axis_name))
     if op == "min":
-        return lax.pmin(x, axis_name)
+        return _timed("all_reduce", x, axis_name,
+                      lambda: lax.pmin(x, axis_name))
     raise ValueError(f"unsupported reduce op {op}")
 
 
 def all_gather(x: jax.Array, axis_name: AxisName, axis: int = 0,
                tiled: bool = True) -> jax.Array:
     """Reference comm.py:310 (all_gather_into_tensor)."""
-    _log("all_gather", x, axis_name)
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    return _timed("all_gather", x, axis_name,
+                  lambda: lax.all_gather(x, axis_name, axis=axis,
+                                         tiled=tiled))
 
 
 def reduce_scatter(x: jax.Array, axis_name: AxisName, axis: int = 0,
                    tiled: bool = True) -> jax.Array:
     """Reference comm.py:293 (reduce_scatter_tensor) — the ZeRO-2 hot path
     (stage_1_and_2.py:average_tensor:1184)."""
-    _log("reduce_scatter", x, axis_name)
-    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+    return _timed("reduce_scatter", x, axis_name,
+                  lambda: lax.psum_scatter(x, axis_name,
+                                           scatter_dimension=axis,
+                                           tiled=tiled))
 
 
 def all_to_all(x: jax.Array, axis_name: AxisName, split_axis: int,
                concat_axis: int, tiled: bool = True) -> jax.Array:
     """Reference comm.py:344 (all_to_all_single) — the Ulysses/MoE hot path
     (sequence/layer.py:single_all_to_all:221, moe/sharded_moe.py:_AllToAll:96)."""
-    _log("all_to_all", x, axis_name)
-    return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=tiled)
+    return _timed("all_to_all", x, axis_name,
+                  lambda: lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                         concat_axis=concat_axis,
+                                         tiled=tiled))
 
 
 def ppermute(x: jax.Array, axis_name: AxisName, perm) -> jax.Array:
     """Point-to-point ring shift (reference pipe/p2p.py send/recv analogue,
     expressed as a collective permute so XLA can pipeline it on ICI)."""
-    _log("ppermute", x, axis_name)
-    return lax.ppermute(x, axis_name, perm)
+    return _timed("ppermute", x, axis_name,
+                  lambda: lax.ppermute(x, axis_name, perm))
 
 
 def send_recv_next(x: jax.Array, axis_name: AxisName, world: int) -> jax.Array:

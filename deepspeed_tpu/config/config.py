@@ -408,6 +408,30 @@ class ReqTraceConfig(TPUConfigModel):
     buffer_traces: int = Field(default=256, ge=1)
 
 
+class GoodputConfig(TPUConfigModel):
+    """``"telemetry": {"goodput": {...}}`` → telemetry/goodput.py: the
+    per-host wall-clock attribution ledger (goodput vs named badput
+    categories, summing to 100% of process lifetime) plus the
+    profile-on-regression capture trigger. Enabling it also enables the
+    span tracer — the ledger attributes off the tracer ring."""
+    enabled: bool = False
+    #: trailing window for ``goodput/window_fraction`` (the capture
+    #: trigger's signal; lifetime fraction is published separately)
+    window_s: float = Field(default=60.0, gt=0)
+    #: windowed goodput fraction below this arms a one-shot bounded
+    #: jax.profiler capture (0 disables capture entirely; an SLO breach
+    #: latch also triggers while captures are armed)
+    capture_threshold: float = Field(default=0.0, ge=0.0, le=1.0)
+    #: minimum seconds between capture starts
+    capture_cooldown_s: float = Field(default=600.0, ge=0)
+    #: capture length; the profiler is stopped on the next ledger update
+    #: at/after this bound
+    capture_duration_ms: float = Field(default=2000.0, gt=0)
+    #: where profiler dumps land (default: ``dstpu_goodput_captures/``
+    #: in the cwd); each capture gets a timestamped subdirectory
+    capture_dir: Optional[str] = None
+
+
 class TelemetryConfig(TPUConfigModel):
     """``"telemetry"`` block → deepspeed_tpu/telemetry (tracer + registry +
     samplers + diagnostics). Metrics recording and the flight recorder are
@@ -437,6 +461,9 @@ class TelemetryConfig(TPUConfigModel):
     #: request-scoped distributed tracing (its own ``enabled`` gate,
     #: independent of span tracing) — telemetry/reqtrace.py
     reqtrace: ReqTraceConfig = Field(default_factory=ReqTraceConfig)
+    #: goodput/badput wall-clock attribution ledger (its own ``enabled``
+    #: gate; enabling it also enables span tracing) — telemetry/goodput.py
+    goodput: GoodputConfig = Field(default_factory=GoodputConfig)
     #: serve ``GET /metrics`` + ``GET /healthz`` on this port (0 =
     #: ephemeral; None = no server) — telemetry/endpoint.py
     http_port: Optional[int] = Field(default=None, ge=0)

@@ -36,7 +36,7 @@ import os
 import signal
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -269,6 +269,7 @@ class FaultInjector:
                 step=step)
             telemetry.tracer.instant(f"resilience/fault_{e.kind}",
                                      site=site, step=step)
+            _OPEN_FAULTS.append((time.perf_counter(), e.kind))
         except Exception:                            # noqa: BLE001
             pass  # chaos must never crash through its own bookkeeping
 
@@ -276,12 +277,22 @@ class FaultInjector:
 #: THE process-wide injector every hook site consults
 fault_injector = FaultInjector()
 
+#: open injection timestamps awaiting their recovery (FIFO: the oldest
+#: open fault is closed by the next record_recovery call) and the closed
+#: (start, end, kind) intervals the goodput ledger attributes to its
+#: fault_recovery category — perf_counter seconds, the tracer's clock
+_OPEN_FAULTS: List[Tuple[float, str]] = []
+_RECOVERY_INTERVALS: List[Tuple[float, float, str]] = []
+_MAX_INTERVALS = 1024
+
 
 def record_recovery(kind: str, **fields: Any) -> None:
     """Count + flight-record one completed recovery (checkpoint fallback,
     serving requeue drain, elastic resume, skipped poisoned step). The
     acceptance invariant is ``resilience/faults_injected ==
-    resilience/recoveries`` at the end of a chaos run."""
+    resilience/recoveries`` at the end of a chaos run. Also closes the
+    oldest open injection into a (start, end, kind) interval the goodput
+    ledger attributes as ``fault_recovery`` wall time."""
     try:
         from deepspeed_tpu import telemetry
         telemetry.registry.counter(
@@ -290,8 +301,26 @@ def record_recovery(kind: str, **fields: Any) -> None:
         telemetry.flight_recorder.record_event("recovery", recovery=kind,
                                                **fields)
         telemetry.tracer.instant(f"resilience/recovery_{kind}", **fields)
+        if _OPEN_FAULTS:
+            t0, fault_kind = _OPEN_FAULTS.pop(0)
+            _RECOVERY_INTERVALS.append(
+                (t0, time.perf_counter(), fault_kind))
+            del _RECOVERY_INTERVALS[:-_MAX_INTERVALS]
     except Exception:                                # noqa: BLE001
         pass
+
+
+def recovery_intervals() -> List[Tuple[float, float, str]]:
+    """Closed injection→recovery intervals, ``(start, end, kind)`` in
+    perf_counter seconds — the goodput ledger's ``fault_recovery``
+    source."""
+    return list(_RECOVERY_INTERVALS)
+
+
+def clear_recovery_intervals() -> None:
+    """Drop recorded intervals and any open injections (test isolation)."""
+    _OPEN_FAULTS.clear()
+    _RECOVERY_INTERVALS.clear()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1128,6 +1128,10 @@ class DeepSpeedTPUEngine:
                 log_dist("\n" + _explain.render(report))
             except Exception as e:                   # noqa: BLE001
                 logger.warning(f"explain_startup failed (non-fatal): {e}")
+        # goodput ledger: feed it the modeled compute/comm split so the
+        # comm_exposed category can be carved out of train-step time
+        telemetry.goodput_ledger.set_roofline(self._roofline_compute_s,
+                                              self._roofline_comm_s)
         # -- resilience: arm the deterministic fault injector from config
         # (env DSTPU_FAULT_PLAN is merged inside arm()) and push the
         # checkpoint IO retry knobs into the store module
@@ -1223,6 +1227,10 @@ class DeepSpeedTPUEngine:
         if self._mem_sampler is not None and \
                 self.global_steps % max(1, self.config.steps_per_print) == 0:
             self._mem_sampler.sample()
+        # goodput ledger sweep (rate-limited internally; no-op when
+        # telemetry.goodput is off) BEFORE the history flush so the
+        # goodput/* gauges land in the same history record
+        telemetry.goodput_ledger.maybe_update()
         # metric history: when the monitor is enabled the history rides
         # _flush_monitor's registry pass; without one (the common case)
         # feed it here on its own cadence so SLOs still evaluate

@@ -223,6 +223,22 @@ class ServingFrontend:
                     logger.warning(
                         f"serving metric history/SLO init failed: {e}")
                     self._history = self._slo = None
+            # goodput ledger: its own enabled gate; arming it also arms
+            # the span tracer (the ledger attributes serving/engine_step
+            # spans off the tracer ring)
+            gcfg = (tget("goodput") if tcfg is not None else None)
+            gget = ((gcfg or {}).get if isinstance(gcfg, dict)
+                    else lambda k, d=None: getattr(gcfg, k, d))
+            if gcfg is not None and gget("enabled", False):
+                from deepspeed_tpu import telemetry as _telemetry
+                _telemetry.tracer.configure(enabled=True)
+                _telemetry.goodput_ledger.configure(
+                    enabled=True,
+                    window_s=gget("window_s"),
+                    capture_threshold=gget("capture_threshold"),
+                    capture_cooldown_s=gget("capture_cooldown_s"),
+                    capture_duration_ms=gget("capture_duration_ms"),
+                    capture_dir=gget("capture_dir"))
 
     def close(self) -> None:
         """Release frontend-owned resources (the /metrics server, the
@@ -511,6 +527,10 @@ class ServingFrontend:
             if self.watchdog is not None:
                 self.watchdog.disarm()
         self._update_degraded()
+        # goodput ledger sweep (rate-limited internally; no-op unless
+        # telemetry.goodput is on) — BEFORE the out-is-None early return
+        # so idle pumps keep attributing idle seconds
+        telemetry.goodput_ledger.maybe_update()
         if out is None:
             return progressed or bool(self._running or len(self.queue))
         self.metrics.bump("engine_steps")

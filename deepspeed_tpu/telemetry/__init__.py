@@ -74,6 +74,8 @@ from deepspeed_tpu.telemetry.explain import (ExplainReport,  # noqa: F401
                                              resolve_peaks)
 from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
     FlightRecorder, flight_recorder, load_dump)
+from deepspeed_tpu.telemetry.goodput import (GoodputLedger,  # noqa: F401
+                                             goodput_ledger)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               Histogram, MetricsRegistry,
                                               registry)
@@ -105,16 +107,18 @@ __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "MetricHistory", "load_records", "merge_records",
            "resolve_metric", "windowed", "Objective", "SLOEngine",
            "engine_from_config", "evaluate_history", "reqtrace",
-           "ReqTrace", "TraceContext", "critical_path"]
+           "ReqTrace", "TraceContext", "critical_path",
+           "goodput_ledger", "GoodputLedger"]
 
 
 def configure(telemetry_config) -> None:
     """Apply a :class:`~deepspeed_tpu.config.config.TelemetryConfig` to
     the process-wide tracer. Enable-only: an engine whose config leaves
     telemetry off must not silence a tracer something else (bench
-    ``--trace``, a test) already turned on. The ``reqtrace`` sub-block
-    additionally arms request-scoped tracing (its own ``enabled`` gate,
-    independent of the span tracer's)."""
+    ``--trace``, a test) already turned on. The ``reqtrace`` and
+    ``goodput`` sub-blocks additionally arm their own layers (each has
+    its own ``enabled`` gate); enabling goodput also enables the span
+    tracer — the ledger attributes off the tracer ring."""
     if telemetry_config is None:
         return
     rt = getattr(telemetry_config, "reqtrace", None)
@@ -124,6 +128,16 @@ def configure(telemetry_config) -> None:
             head_sample=getattr(rt, "head_sample", None),
             retain_slow_ms=getattr(rt, "retain_slow_ms", None),
             buffer_traces=getattr(rt, "buffer_traces", None))
+    gp = getattr(telemetry_config, "goodput", None)
+    if gp is not None and getattr(gp, "enabled", False):
+        tracer.configure(enabled=True)
+        goodput_ledger.configure(
+            enabled=True,
+            window_s=getattr(gp, "window_s", None),
+            capture_threshold=getattr(gp, "capture_threshold", None),
+            capture_cooldown_s=getattr(gp, "capture_cooldown_s", None),
+            capture_duration_ms=getattr(gp, "capture_duration_ms", None),
+            capture_dir=getattr(gp, "capture_dir", None))
     if not getattr(telemetry_config, "enabled", False):
         return
     tracer.configure(

@@ -64,6 +64,18 @@ def analyze(dumps: List[Dict[str, Any]],
                            if e.get("kind") == "recovery"]
         slo_events = [e for e in doc.get("events", [])
                       if e.get("kind") in ("slo_breach", "slo_recovered")]
+        # goodput ledger state: the black box's own summary section when
+        # present, else reconstructed from the metrics_text exposition
+        gp = doc.get("goodput") if isinstance(doc.get("goodput"), dict) \
+            else None
+        if gp is None and doc.get("metrics_text"):
+            try:
+                from deepspeed_tpu.telemetry.fleet import (
+                    goodput_state, parse_prometheus_text)
+                gp = goodput_state(
+                    parse_prometheus_text(doc["metrics_text"]))
+            except Exception:                        # noqa: BLE001
+                gp = None
         hosts.append({
             "name": _host_name(doc, i),
             "reason": doc.get("reason"),
@@ -80,6 +92,7 @@ def analyze(dumps: List[Dict[str, Any]],
             "compile_functions": (doc.get("compile") or {}).get(
                 "functions", {}),
             "slo_events": slo_events,
+            "goodput": gp,
         })
         # predicted vs achieved: when the black box carries an explain
         # snapshot (telemetry/explain.py), compare its roofline
@@ -246,6 +259,16 @@ def analyze(dumps: List[Dict[str, Any]],
     nonfinite = [e for e in timeline
                  if str(e.get("anomaly", "")).startswith("nonfinite")]
 
+    # -- goodput: worst ledger fraction across reporting hosts; below
+    # LOW_GOODPUT_FRACTION the verdict names the dominant badput
+    from deepspeed_tpu.telemetry.goodput import LOW_GOODPUT_FRACTION
+    low_goodput = sorted(
+        (h for h in hosts
+         if isinstance((h.get("goodput") or {}).get("fraction"),
+                       (int, float))
+         and h["goodput"]["fraction"] < LOW_GOODPUT_FRACTION),
+        key=lambda h: h["goodput"]["fraction"])
+
     # -- verdict, most fatal condition first
     crashed = [h for h in hosts if h["exception"]]
     hung = [h for h in hosts if h["watchdog"]]
@@ -290,6 +313,15 @@ def analyze(dumps: List[Dict[str, Any]],
                    f"{e.get('op')} {e.get('target')}) still burning at "
                    f"{e.get('burn_fast')}x budget "
                    f"(last value {e.get('value')})")
+    elif low_goodput:
+        h = low_goodput[0]
+        gp = h["goodput"]
+        dom = gp.get("dominant_badput") or "other"
+        dom_s = gp.get("dominant_badput_s") or \
+            (gp.get("badput") or {}).get(dom, 0.0)
+        verdict = (f"LOW GOODPUT on {h['name']}: "
+                   f"{100.0 * gp['fraction']:.0f}% of wall clock was "
+                   f"productive; dominant badput {dom} ({dom_s:.1f}s)")
     elif straggler and straggler["significant"]:
         verdict = (f"STRAGGLER: {straggler['host']} runs "
                    f"{straggler['skew']:.2f}x slower than the fastest "
@@ -318,6 +350,8 @@ def analyze(dumps: List[Dict[str, Any]],
             "recovery_timeline": recovery_timeline,
             "reqtrace": {"slow_requests": slow_requests, **trace_drops},
             "crash_looping": crash_looping, "draining": draining,
+            "goodput": {"low": [{"host": h["name"], **h["goodput"]}
+                                for h in low_goodput]},
             "resilience": {"faults_injected": n_faults,
                            "recoveries": n_recoveries,
                            "unrecovered": max(0, n_faults - n_recoveries)}}
@@ -382,6 +416,23 @@ def render(report: Dict[str, Any]) -> str:
             out.append(f"  {h['name']:<24}predicted "
                        f"{r['predicted_ms']:.2f} ms "
                        f"({r.get('bound')}-bound) — {pct}")
+    gp_hosts = [h for h in report["hosts"] if h.get("goodput")]
+    if gp_hosts:
+        out.append("")
+        out.append("goodput ledger (share of wall clock that was "
+                   "productive; dominant badput named):")
+        for h in gp_hosts:
+            gp = h["goodput"]
+            frac = gp.get("fraction")
+            frac_s = (f"{100.0 * frac:.0f}%"
+                      if isinstance(frac, (int, float)) else "-")
+            dom = gp.get("dominant_badput")
+            dom_s = (f"  dominant badput: {dom} "
+                     f"({gp.get('dominant_badput_s', 0.0):.1f}s)"
+                     if dom else "")
+            caps = (f"  captures: {gp['captures']}"
+                    if gp.get("captures") else "")
+            out.append(f"  {h['name']:<24}goodput {frac_s}{dom_s}{caps}")
     slo = report.get("slo") or {}
     if slo.get("timeline"):
         out.append("")

@@ -191,6 +191,7 @@ class HostSample:
         m = self.metrics
         ttft = m.get("serving_ttft_seconds")
         tpot = m.get("serving_tpot_seconds")
+        gp = goodput_state(m)
         return {
             "host": self.target,
             "status": self.status,
@@ -214,6 +215,9 @@ class HostSample:
             "autoscale": autoscale_targets(m),
             "kvtier": kvtier_state(m),
             "exemplars": latency_exemplars(m),
+            "goodput_pct": None if gp is None else
+            100.0 * gp["fraction"],
+            "goodput": gp,
         }
 
 
@@ -248,6 +252,28 @@ def kvtier_state(metrics: Dict[str, Any]) -> Optional[Dict[str, float]]:
         if isinstance(v, (int, float)):
             out[short] = float(v)
     return out or None
+
+
+def goodput_state(metrics: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Goodput ledger state from a host's parsed exposition (the
+    ``goodput_*`` gauges telemetry/goodput.py publishes): lifetime
+    fraction plus the dominant badput category and its seconds. None
+    when the host does not run the ledger."""
+    frac = metrics.get("goodput_fraction")
+    if not isinstance(frac, (int, float)):
+        return None
+    from deepspeed_tpu.telemetry.goodput import CATEGORIES
+    badput = {}
+    for cat in CATEGORIES:
+        if cat == "goodput":
+            continue
+        v = metrics.get(f"goodput_{cat}_s")
+        if isinstance(v, (int, float)) and v > 0:
+            badput[cat] = float(v)
+    dominant = max(badput, key=badput.get) if badput else None
+    return {"fraction": float(frac), "badput": badput,
+            "dominant_badput": dominant,
+            "dominant_badput_s": badput.get(dominant, 0.0)}
 
 
 def latency_exemplars(metrics: Dict[str, Any]
@@ -352,6 +378,21 @@ def rows_from_history(paths: List[str],
             return (vb - va) / dt
 
         breached = metric(("slo/breached",))
+        gfrac = metric(("goodput/fraction",))
+        gp = None
+        if gfrac is not None:
+            from deepspeed_tpu.telemetry.goodput import CATEGORIES
+            badput = {}
+            for cat in CATEGORIES:
+                if cat == "goodput":
+                    continue
+                v = metric((f"goodput/{cat}_s",))
+                if v is not None and v > 0:
+                    badput[cat] = float(v)
+            dominant = max(badput, key=badput.get) if badput else None
+            gp = {"fraction": float(gfrac), "badput": badput,
+                  "dominant_badput": dominant,
+                  "dominant_badput_s": badput.get(dominant, 0.0)}
         rows.append({
             "host": host,
             "status": "degraded" if breached else "ok",
@@ -367,6 +408,9 @@ def rows_from_history(paths: List[str],
             "tok_rate": rate(H_TOKENS),
             "burn": metric(H_BURN),
             "stale_s": max(0.0, now - last.get("ts", now)),
+            "goodput_pct": None if gp is None else
+            100.0 * gp["fraction"],
+            "goodput": gp,
         })
     return rows
 
@@ -381,6 +425,13 @@ def publish_fleet_gauges(rows: List[Dict[str, Any]]) -> None:
     registry.gauge("fleet/staleness_s_max").set(max(stales, default=0.0))
     burns = [r["burn"] for r in rows if r["burn"] is not None]
     registry.gauge("fleet/worst_burn").set(max(burns, default=0.0))
+    fracs = [r["goodput_pct"] / 100.0 for r in rows
+             if r.get("goodput_pct") is not None]
+    if fracs:
+        registry.gauge(
+            "fleet/goodput_fraction",
+            help="mean lifetime goodput fraction over reporting hosts"
+        ).set(sum(fracs) / len(fracs))
 
 
 _COLS = [
@@ -394,6 +445,7 @@ _COLS = [
     ("TPOT*", "tpot_p99_ms", "{:.1f}", 8),
     ("TOK/S", "tok_rate", "{:.1f}", 8),
     ("BURN", "burn", "{:.2f}", 6),
+    ("GOOD%", "goodput_pct", "{:.0f}", 5),
     ("STALE", "stale_s", "{:.0f}s", 6),
 ]
 
@@ -429,6 +481,11 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
                    if isinstance(e.get("value"), (int, float)) else "")
                 for k, e in r["exemplars"].items())
             lines.append(f"    └─ tail exemplars: {pairs}")
+        gp = r.get("goodput")
+        if gp and gp.get("dominant_badput"):
+            lines.append(f"    └─ badput: dominant "
+                         f"{gp['dominant_badput']} "
+                         f"({gp['dominant_badput_s']:.1f}s)")
     degraded = sum(1 for r in rows if r["status"] not in ("ok",))
     lines.append(f"hosts: {len(rows)}  degraded: {degraded}  "
                  f"(* = interval percentile, ms)")
@@ -447,7 +504,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="offline mode: per-host metric history JSONL "
                          "files instead of live endpoints")
     ap.add_argument("--once", action="store_true",
-                    help="render one frame and exit (CI / tests)")
+                    help="render one frame and exit (CI / tests); exit "
+                         "0 healthy, 2 degraded/down hosts, 3 fleet "
+                         "goodput below --min-goodput")
+    ap.add_argument("--min-goodput", type=float, default=None,
+                    metavar="FRAC",
+                    help="with --once: exit 3 when the fleet mean "
+                         "goodput fraction (hosts running the ledger) "
+                         "is below this floor, 0-1")
     ap.add_argument("--json", action="store_true",
                     help="emit rows as JSON instead of the table")
     ap.add_argument("--interval", type=float, default=DEFAULT_INTERVAL_S,
@@ -480,7 +544,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.once:
             degraded = sum(1 for r in rows
                            if r["status"] not in ("ok",))
-            return 2 if degraded else 0
+            if degraded:
+                return 2        # degraded outranks the goodput floor
+            if args.min_goodput is not None:
+                fracs = [r["goodput_pct"] / 100.0 for r in rows
+                         if r.get("goodput_pct") is not None]
+                if fracs and sum(fracs) / len(fracs) < args.min_goodput:
+                    return 3
+            return 0
         first = False
         try:
             time.sleep(args.interval)

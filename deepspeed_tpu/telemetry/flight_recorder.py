@@ -217,6 +217,15 @@ class FlightRecorder:
         except Exception:
             pass
         try:
+            # goodput ledger summary (fraction, badput taxonomy,
+            # profiler-capture paths) — dstpu-doctor's GOODPUT verdict
+            # reads this section
+            from deepspeed_tpu.telemetry.goodput import goodput_ledger
+            if goodput_ledger.enabled:
+                doc["goodput"] = goodput_ledger.summary()
+        except Exception:
+            pass
+        try:
             from deepspeed_tpu.telemetry.sampler import host_rss_bytes
             rss = host_rss_bytes()
             if rss is not None:

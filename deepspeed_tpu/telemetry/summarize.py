@@ -256,6 +256,9 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None,
                     help="with --request: write the merged trace JSON "
                          "here (load in ui.perfetto.dev)")
+    ap.add_argument("--goodput", action="store_true",
+                    help="append the goodput/badput attribution of the "
+                         "trace (telemetry/goodput.py ledger sweep)")
     args = ap.parse_args(argv)
     if args.request:
         rep = assemble_request(args.trace, args.request, out=args.out)
@@ -287,6 +290,21 @@ def main(argv=None) -> int:
     if n_instant:
         print(f"\n({n_instant} instant events not shown — e.g. comm/* "
               f"trace-time markers)")
+    if args.goodput:
+        from deepspeed_tpu.telemetry import goodput as _goodput
+        spans = [e for e in events if e.get("ph") == "X"]
+        if spans:
+            t0 = min(float(e["ts"]) for e in spans) / 1e6
+            t1 = max(float(e["ts"]) + float(e.get("dur", 0.0))
+                     for e in spans) / 1e6
+            res = _goodput.attribute(events, t0, t1, base=0.0)
+            sec = res["seconds"]
+            print("\ngoodput attribution (trace extent "
+                  f"{t1 - t0:.3f}s):")
+            print(_goodput.format_ledger({
+                "uptime_s": t1 - t0, "goodput_s": sec["goodput"],
+                "badput": {c: sec[c] for c in _goodput.CATEGORIES
+                           if c != "goodput"}}))
     return 0
 
 

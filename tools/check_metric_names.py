@@ -47,7 +47,7 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
-               "fleet", "handoff", "kvtier", "mem", "overlap",
+               "fleet", "goodput", "handoff", "kvtier", "mem", "overlap",
                "resilience", "roofline", "router", "serving", "slo",
                "trace", "train", "tune")
 
@@ -173,6 +173,48 @@ def check_fault_kinds(pkg_root: str) -> List[str]:
             for k in kinds if k not in doc]
 
 
+def collect_goodput_categories(pkg_root: str) -> List[str]:
+    """Every ledger category declared in telemetry/goodput.py: the
+    string elements of module-level ``*CATEGORIES`` tuple assignments
+    (the taxonomy the attribution sweep classifies into)."""
+    path = os.path.join(pkg_root, "telemetry", "goodput.py")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    cats: List[str] = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("CATEGORIES")):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                cats.append(sub.value)
+    return list(dict.fromkeys(cats))
+
+
+def check_goodput_categories(pkg_root: str) -> List[str]:
+    """Every ledger category must appear in docs/observability.md —
+    mirrors the fault-catalog check: an undocumented badput category is
+    an attribution nobody can act on from the runbook."""
+    cats = collect_goodput_categories(pkg_root)
+    if not cats:
+        return []
+    doc_path = os.path.join(os.path.dirname(pkg_root), "docs",
+                            "observability.md")
+    if not os.path.exists(doc_path):
+        return [f"docs/observability.md missing but telemetry/goodput.py "
+                f"declares {len(cats)} ledger categories"]
+    with open(doc_path, encoding="utf-8") as fh:
+        doc = fh.read()
+    return [f"telemetry/goodput.py declares ledger category {c!r} but "
+            f"docs/observability.md never mentions it (document it in "
+            f"the goodput-ledger taxonomy)"
+            for c in cats if c not in doc]
+
+
 def collect_span_names(pkg_root: str) -> List[Tuple[str, int, str]]:
     """(file, line, span_name) for every literal-name ``span`` /
     ``instant`` / ``complete`` call site under the serving tier
@@ -242,13 +284,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     errors = check(sites)
     errors += check_fault_kinds(root)
     errors += check_span_names(root)
+    errors += check_goodput_categories(root)
     for e in errors:
         print(e)
     if not errors:
         spans = {name for _, _, name in collect_span_names(root)}
         print(f"check_metric_names: {len(sites)} literal call sites OK; "
               f"{len(collect_fault_kinds(root))} fault kinds documented; "
-              f"{len(spans)} span names documented")
+              f"{len(spans)} span names documented; "
+              f"{len(collect_goodput_categories(root))} goodput "
+              f"categories documented")
     return 1 if errors else 0
 
 
