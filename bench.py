@@ -284,6 +284,12 @@ def moe_main(args) -> None:
         "steps_per_print": 1000,
     }
     _apply_bench_slo(config)
+    # DSTPU_BENCH_HEALTH=<every> arms the in-graph model-health taps at
+    # that cadence for the benched engine (stamped into extra.health)
+    hb_every = int(os.environ.get("DSTPU_BENCH_HEALTH", "0") or 0)
+    if hb_every:
+        config["telemetry"] = {"health": {"enabled": True,
+                                          "every": hb_every}}
     engine, *_ = ds.initialize(model=model, config=config,
                                rng=jax.random.PRNGKey(0))
     gb = int(engine.config.train_batch_size)
@@ -333,10 +339,101 @@ def moe_main(args) -> None:
         }
     except Exception:
         pass
+    if hb_every:
+        result["extra"]["health"] = _health_extra()
     print(json.dumps(result))
     if getattr(args, "trace", None):
         from deepspeed_tpu.telemetry import tracer
         tracer.dump(args.trace)
+
+
+def _health_extra():
+    """Final ``health/*`` gauge snapshot → the BENCH ``extra.health``
+    stamp ({} on any failure — the stamp must never take the bench
+    down)."""
+    try:
+        from deepspeed_tpu.telemetry.registry import registry
+        snap = registry.snapshot(interval=False)
+        return {k.split("/", 1)[1].replace("/", "_"): round(float(v), 4)
+                for k, v in sorted(snap.items())
+                if k.startswith("health/") and "layer/" not in k
+                and "expert/" not in k
+                and isinstance(v, (int, float))}
+    except Exception:                                # noqa: BLE001
+        return {}
+
+
+def health_main(args) -> None:
+    """--health-ab: A/B the in-graph model-health taps (health.every=1 —
+    stats computed in-graph AND fetched every step) against the same
+    engine with telemetry.health disabled: identical model, mesh, rng
+    and data. The BENCH value is the step-time overhead in percent; the
+    acceptance bar for the static-flag design is <5%, with zero extra
+    retraces per engine (asserted against the compile counter)."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.mixtral import mixtral_config
+
+    dev0 = jax.devices()[0]
+    n_dev = len(jax.devices())
+    on_tpu = dev0.platform == "tpu"
+    seq = args.seq or (2048 if on_tpu else 128)
+    batch = args.batch or n_dev
+    steps = args.steps or (24 if on_tpu else 6)
+    warmup = 3 if on_tpu else 2
+    ds.build_mesh(data=n_dev)
+    model = mixtral_config("tiny", max_seq_len=seq)
+
+    def run(health):
+        config = {
+            "train_micro_batch_size_per_gpu": max(1, batch // n_dev),
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 0},
+            "moe": {"impl": "dropless"},
+            "steps_per_print": 1000,
+        }
+        if health:
+            config["telemetry"] = {"health": {"enabled": True,
+                                              "every": 1}}
+        traces0 = telemetry.compile_monitor.retrace_count(
+            "engine/fused_step")
+        engine, *_ = ds.initialize(model=model, config=config,
+                                   rng=jax.random.PRNGKey(0))
+        gb = int(engine.config.train_batch_size)
+        rng = np.random.default_rng(0)
+        batches = [{"input_ids": rng.integers(
+            0, model.vocab_size, size=(gb, seq), dtype=np.int32)}
+            for _ in range(4)]
+        for i in range(warmup):
+            float(engine.train_batch(iter([batches[i % 4]])))
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            loss = engine.train_batch(iter([batches[i % 4]]))
+        loss = float(loss)
+        dt = time.perf_counter() - t0
+        return {"step_ms": round(dt / steps * 1e3, 3),
+                "loss": round(loss, 6),
+                "retraces": telemetry.compile_monitor.retrace_count(
+                    "engine/fused_step") - traces0}
+
+    base = run(False)
+    taps = run(True)
+    overhead = (taps["step_ms"] / base["step_ms"] - 1.0) \
+        if base["step_ms"] else 0.0
+    result = {
+        "metric": f"model-health taps A/B mixtral-tiny seq{seq} "
+                  f"dp{n_dev} {dev0.platform} (every=1 vs off)",
+        "value": round(overhead * 100.0, 2),
+        "unit": "% step-time overhead",
+        "extra": {"baseline": base, "health": taps,
+                  "health_stamp": _health_extra(),
+                  "platform": dev0.platform, "n_devices": n_dev,
+                  "steps": steps, "seq": seq},
+    }
+    print(json.dumps(result))
 
 
 def overlap_main(args) -> None:
@@ -578,6 +675,10 @@ def main() -> None:
                     help="record host-side spans and dump Chrome trace-event"
                          " JSON here (inspect with bin/dstpu-trace or "
                          "ui.perfetto.dev)")
+    ap.add_argument("--health-ab", action="store_true",
+                    help="A/B the in-graph model-health taps "
+                         "(telemetry.health every=1 vs disabled) on the "
+                         "tiny MoE bench and report % step-time overhead")
     ap.add_argument("--chaos", action="store_true",
                     help="run a short training loop under a scripted "
                          "fault plan (dstpu-chaos) and report the "
@@ -597,6 +698,9 @@ def main() -> None:
         return
     if args.chaos:
         chaos_main(args)
+        return
+    if args.health_ab:
+        health_main(args)
         return
     if args.overlap:
         overlap_main(args)

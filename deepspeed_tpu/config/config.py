@@ -432,6 +432,34 @@ class GoodputConfig(TPUConfigModel):
     capture_dir: Optional[str] = None
 
 
+class HealthConfig(TPUConfigModel):
+    """``"telemetry": {"health": {...}}`` → telemetry/health.py: in-graph
+    model-health statistics (per-layer grad/param/update norms, activation
+    RMS/absmax, MoE expert load + routing entropy) computed as extra
+    outputs of the already-jitted fused train step. The stat branch is
+    baked in at trace time — the flag never flips mid-run, so on- and
+    off-cadence steps execute the *identical* program (zero retraces);
+    ``every`` only gates the host-side fetch/publish."""
+    enabled: bool = False
+    #: fetch + publish ``health/*`` gauges every N steps (stats are
+    #: computed on-device every step; off-cadence steps skip the host
+    #: transfer entirely)
+    every: int = Field(default=50, ge=1)
+    #: tap per-layer activation RMS/absmax (and MoE router stats) from
+    #: the forward pass; off → only optimizer-side per-layer norms
+    activations: bool = True
+    #: publish per-layer gauges for at most this many layers (0 = all);
+    #: aggregates + the localizer always see every layer
+    max_layers: int = Field(default=0, ge=0)
+    #: |z| of a layer's grad-norm against its own rolling window past
+    #: this flags ``anomaly/layer_divergence`` naming the layer
+    z_threshold: float = Field(default=6.0, gt=0)
+    #: an expert whose windowed mean load fraction sits below
+    #: ``dead_fraction / num_experts`` counts dead; persistent deadness
+    #: flags ``anomaly/expert_collapse`` naming the expert
+    dead_fraction: float = Field(default=0.1, gt=0, le=1.0)
+
+
 class TelemetryConfig(TPUConfigModel):
     """``"telemetry"`` block → deepspeed_tpu/telemetry (tracer + registry +
     samplers + diagnostics). Metrics recording and the flight recorder are
@@ -464,6 +492,9 @@ class TelemetryConfig(TPUConfigModel):
     #: goodput/badput wall-clock attribution ledger (its own ``enabled``
     #: gate; enabling it also enables span tracing) — telemetry/goodput.py
     goodput: GoodputConfig = Field(default_factory=GoodputConfig)
+    #: in-graph per-layer / per-expert model-health stats (its own
+    #: ``enabled`` gate) — telemetry/health.py
+    health: HealthConfig = Field(default_factory=HealthConfig)
     #: serve ``GET /metrics`` + ``GET /healthz`` on this port (0 =
     #: ephemeral; None = no server) — telemetry/endpoint.py
     http_port: Optional[int] = Field(default=None, ge=0)

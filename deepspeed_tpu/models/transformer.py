@@ -112,6 +112,14 @@ class DecoderConfig:
     sliding_window: Optional[int] = None
     #: untied lm_head carries a bias vector (HF Phi's ``lm_head.bias``)
     lm_head_bias: bool = False
+    #: model-health stat taps (telemetry/health.py): the scan body emits
+    #: a per-layer stats dict (aux_loss, activation RMS/absmax, MoE
+    #: expert load + routing entropy) instead of the scalar aux, and
+    #: ``forward_hidden`` returns it stacked [L] as a third output.
+    #: Trace-time static — only the training loss_fn ever sets it (on a
+    #: replaced config instance), so inference/pipeline callers keep the
+    #: 2-tuple contract.
+    health_taps: bool = False
     #: False → bidirectional (encoder: BERT/DistilBERT). The reference's
     #: encoder containers are module_inject/containers/bert.py and
     #: distil_bert.py; here encoders are the same scan core with the
@@ -626,12 +634,26 @@ def decoder_block(cfg: DecoderConfig, p: Params, x: jax.Array, sin, cos,
                   layer_window: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hidden, aux_loss) — aux is 0 for dense blocks, the scaled
-    load-balance loss for MoE blocks (reference sharded_moe.py l_aux)."""
+    load-balance loss for MoE blocks (reference sharded_moe.py l_aux).
+
+    Under ``cfg.health_taps`` the second output is instead a per-layer
+    stats dict ({aux_loss, act_rms, act_absmax} + MoE router stats) that
+    ``lax.scan`` stacks into [L]-leading arrays for telemetry/health.py.
+    """
     pre = _norm(cfg, p["ln1"], x) if cfg.prenorm else x
     attn_out = _attention_block(cfg, p["attn"], pre, sin, cos, attn_fn,
                                 layer_window)
     attn_out = checkpoint_name(attn_out, "attn_out")
-    return block_combine(cfg, p, x, pre, attn_out, moe_fn)
+    if not getattr(cfg, "health_taps", False):
+        return block_combine(cfg, p, x, pre, attn_out, moe_fn)
+    h, aux, rstats = block_combine(cfg, p, x, pre, attn_out, moe_fn)
+    hf = h.astype(jnp.float32)
+    stats = {"aux_loss": aux,
+             "act_rms": jnp.sqrt(jnp.mean(jnp.square(hf))),
+             "act_absmax": jnp.max(jnp.abs(hf))}
+    if rstats is not None:
+        stats.update(rstats)
+    return h, stats
 
 
 def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
@@ -649,7 +671,11 @@ def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
     """
     def ffn(src):
         if cfg.num_experts and moe_fn is not None:
-            out, aux = moe_fn(cfg, p["moe"], src)
+            ret = moe_fn(cfg, p["moe"], src)
+            out, aux = ret[0], ret[1]
+            # 3rd element = router-health stats, present iff the moe
+            # layer saw cfg.health_taps (parallel/moe.py)
+            rstats = ret[2] if len(ret) > 2 else None
             if "residual" in p["moe"]:
                 # Residual-MoE (reference moe/layer.py use_residual):
                 # learned convex mix of the routed output and a dense MLP
@@ -660,28 +686,32 @@ def block_combine(cfg: DecoderConfig, p: Params, x: jax.Array,
                     + p["moe"]["coef_b"].astype(jnp.float32),
                     axis=-1).astype(src.dtype)
                 out = out * coef[..., 0:1] + res * coef[..., 1:2]
-            return out, aux
+            return out, aux, rstats
         if cfg.ffn_chunk and src.shape[1] > cfg.ffn_chunk:
             # FPDT chunked MLP: [T, ffn]-sized activations become
             # [ffn_chunk, ffn]-sized (parallel/fpdt.fpdt_ffn)
             from deepspeed_tpu.parallel.fpdt import fpdt_ffn
             return (fpdt_ffn(partial(_mlp, cfg, p["mlp"]), src,
                              chunk=cfg.ffn_chunk),
-                    jnp.zeros((), jnp.float32))
-        return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32)
+                    jnp.zeros((), jnp.float32), None)
+        return _mlp(cfg, p["mlp"], src), jnp.zeros((), jnp.float32), None
 
     if not cfg.prenorm:
         h = _norm(cfg, p["ln1"], x + attn_out)
-        ff, aux = ffn(h)
-        return _norm(cfg, p["ln2"], h + ff), aux
-    if cfg.parallel_block:
+        ff, aux, rstats = ffn(h)
+        out = _norm(cfg, p["ln2"], h + ff)
+    elif cfg.parallel_block:
         src = _norm(cfg, p["ln2"], x) if cfg.parallel_block_norms == 2 \
             else pre
-        ff, aux = ffn(src)
-        return x + attn_out + ff, aux
-    h = x + attn_out
-    ff, aux = ffn(_norm(cfg, p["ln2"], h))
-    return h + ff, aux
+        ff, aux, rstats = ffn(src)
+        out = x + attn_out + ff
+    else:
+        h = x + attn_out
+        ff, aux, rstats = ffn(_norm(cfg, p["ln2"], h))
+        out = h + ff
+    if getattr(cfg, "health_taps", False):
+        return out, aux, rstats
+    return out, aux
 
 
 # ---------------------------------------------------------------------------
@@ -873,6 +903,11 @@ def forward_hidden(cfg: DecoderConfig, params: Params, tokens: jax.Array,
         x, aux = lax.scan(body, x, scan_xs)
     if cfg.has_final_norm:
         x = _norm(cfg, params["final_norm"], x)
+    if getattr(cfg, "health_taps", False):
+        # aux is the scan-stacked per-layer stats dict ([L]-leading
+        # leaves); the loss consumes only the aux_loss component, the
+        # rest flows to telemetry/health.py as a third output
+        return x, jnp.sum(aux["aux_loss"]), aux
     return x, jnp.sum(aux)
 
 

@@ -302,6 +302,16 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
                == topi[0][None, :]).astype(jnp.float32)       # [E,S]
     aux = jnp.sum(gates_t.mean(axis=1) * mask1_t.mean(axis=1)) * e
 
+    # routing-health taps (telemetry/health.py): per-expert top-1 load
+    # fraction + mean token routing entropy. Static flag on the model
+    # config — serving configs never set it, so the 2-tuple return
+    # contract of every inference caller is untouched.
+    stats = None
+    if getattr(cfg, "health_taps", False):
+        stats = {"expert_load": mask1_t.mean(axis=1),
+                 "router_entropy": -jnp.mean(jnp.sum(
+                     gates_t * jnp.log(gates_t + 1e-9), axis=0))}
+
     batch_axes: Tuple[str, ...] = ()
     from deepspeed_tpu.parallel.mesh import get_mesh, has_mesh
     mesh = get_mesh() if has_mesh() else None
@@ -326,6 +336,8 @@ def dropless_moe_layer(cfg, p, x: jax.Array,
         out = fn(p, xf, topv, topi)
     else:
         out = _dropless_ffn(p, xf, topv, topi, top_k)
+    if stats is not None:
+        return out.reshape(b, t, d), aux * aux_loss_coef, stats
     return out.reshape(b, t, d), aux * aux_loss_coef
 
 
@@ -404,6 +416,18 @@ def moe_layer(cfg, p, x: jax.Array,
                                          norm_probs=norm_topk,
                                          rts_key=rts_key)
 
+    # routing-health taps — see dropless_moe_layer. Load is the top-1
+    # assignment fraction from the raw logits (pre-RTS-noise, matching
+    # the aux loss's ce term); entropy is the mean token routing entropy.
+    stats = None
+    if getattr(cfg, "health_taps", False):
+        gates = jax.nn.softmax(logits, axis=-1)               # [S,E]
+        top1 = jax.nn.one_hot(jnp.argmax(logits, axis=-1), e,
+                              dtype=jnp.float32)
+        stats = {"expert_load": top1.mean(axis=0),
+                 "router_entropy": -jnp.mean(jnp.sum(
+                     gates * jnp.log(gates + 1e-9), axis=-1))}
+
     ep_mesh = None
     if ep_axis is not None:
         from deepspeed_tpu.parallel.mesh import get_mesh
@@ -452,4 +476,6 @@ def moe_layer(cfg, p, x: jax.Array,
 
     if "shared" in p:   # Qwen2-MoE/DeepSeek: dense expert on every token
         out = out + _shared_expert(p["shared"], xf)
+    if stats is not None:
+        return out.reshape(b, t, d), aux * aux_loss_coef, stats
     return out.reshape(b, t, d), aux * aux_loss_coef

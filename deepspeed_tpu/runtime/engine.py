@@ -383,7 +383,8 @@ class DeepSpeedTPUEngine:
             grads, self.plan.grad_shardings())
         return loss, metrics, grads
 
-    def _apply_update(self, params, opt_state, scaler, grads, step, gas):
+    def _apply_update(self, params, opt_state, scaler, grads, step, gas,
+                      fwd_metrics=None):
         cfg = self.config
         inv = 1.0 / (scaler.scale * gas)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
@@ -393,6 +394,9 @@ class DeepSpeedTPUEngine:
         sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                  for g in jax.tree.leaves(grads))
         grad_norm = jnp.sqrt(sq)
+        # per-layer health norms use the same pre-clip convention as the
+        # global grad norm above
+        unclipped = grads
         if cfg.gradient_clipping > 0:
             clip = jnp.minimum(1.0, cfg.gradient_clipping /
                                (grad_norm + 1e-6))
@@ -416,26 +420,69 @@ class DeepSpeedTPUEngine:
         metrics = {"lr": lr, "grad_norm": grad_norm,
                    "loss_scale": scaler.scale,
                    "overflow": overflow.astype(jnp.int32)}
+        if fwd_metrics and "aux_loss" in fwd_metrics:
+            metrics["aux_loss"] = fwd_metrics["aux_loss"]
+        if getattr(self, "_health_enabled", False):
+            health = self._per_layer_health(params, unclipped, new_params)
+            fh = (fwd_metrics or {}).get("health")
+            if fh:
+                health = {**health, **fh}
+            if health:
+                metrics["health"] = health
         return new_params, new_opt, scaler, metrics
+
+    @staticmethod
+    def _per_layer_health(params, grads, new_params):
+        """In-graph per-layer training dynamics over the stacked
+        ``params['layers']`` subtree (under the scanned-decoder layout
+        every leaf there carries a leading [L] layer axis): per-layer
+        grad norm, param norm, and the update/param ratio — the classic
+        divergence precursors. Pure [L]-vector reductions fused into the
+        step program; models without a stacked ``layers`` subtree simply
+        contribute no per-layer optimizer stats."""
+        if not (isinstance(params, dict) and "layers" in params):
+            return {}
+
+        def per_layer_sq(tree):
+            tot = None
+            for leaf in jax.tree.leaves(tree):
+                if leaf.ndim < 1:
+                    continue
+                s = jnp.sum(jnp.square(leaf.astype(jnp.float32)),
+                            axis=tuple(range(1, leaf.ndim)))
+                tot = s if tot is None else tot + s
+            return tot
+
+        g = per_layer_sq(grads["layers"])
+        if g is None:
+            return {}
+        p = per_layer_sq(params["layers"])
+        u = per_layer_sq(jax.tree.map(
+            lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+            new_params["layers"], params["layers"]))
+        param_norm = jnp.sqrt(p)
+        return {"grad_norm": jnp.sqrt(g), "param_norm": param_norm,
+                "update_ratio": jnp.sqrt(u) / (param_norm + 1e-12)}
 
     def _accumulate_grads(self, params, batch, scale, rng):
         """Shared GAS scan: stacked microbatches [gas, ...] → (fp32 grad
-        sum carrying the ZeRO grad shardings, per-micro losses)."""
+        sum carrying the ZeRO grad shardings, per-micro losses, loss_fn
+        metrics pytree stacked on a leading [gas] axis)."""
         def micro(carry, mb):
             acc, r = carry
             r, sub = jax.random.split(r)
-            loss, _m, grads = self._compute_loss_and_grads(
+            loss, m, grads = self._compute_loss_and_grads(
                 params, mb, scale, sub)
             acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), acc, grads)
-            return (acc, r), loss
+            return (acc, r), (loss, m)
 
         zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                             params)
         zero = jax.lax.with_sharding_constraint(
             zero, self.plan.grad_shardings())
-        (acc, _), losses = jax.lax.scan(micro, (zero, rng), batch)
-        return acc, losses
+        (acc, _), (losses, fwd) = jax.lax.scan(micro, (zero, rng), batch)
+        return acc, losses, fwd
 
     def _build_step_functions(self) -> None:
         gas = int(self.config.gradient_accumulation_steps)
@@ -474,8 +521,8 @@ class DeepSpeedTPUEngine:
             transfer_dtype = self.compute_dtype
 
             def grads_only(params, batch, scale, rng):
-                acc, losses = self._accumulate_grads(params, batch, scale,
-                                                     rng)
+                acc, losses, _fm = self._accumulate_grads(params, batch,
+                                                          scale, rng)
                 acc = jax.tree.map(lambda g: g * (1.0 / gas), acc)
                 return layout.flatten_device(acc, transfer_dtype), \
                     jnp.mean(losses)
@@ -560,20 +607,26 @@ class DeepSpeedTPUEngine:
 
         # fused train_batch step: batch leaves have leading [gas, ...] dim
         def fused_step(params, opt_state, scaler, batch, step, rng):
+            # runs at trace time only: the zero-retrace guarantee for the
+            # health taps is asserted against this counter
+            telemetry.compile_monitor.count_trace("engine/fused_step")
             if gas == 1:
                 mb = jax.tree.map(lambda x: x[0], batch)
                 rng, sub = jax.random.split(rng)
-                loss, _m, acc = self._compute_loss_and_grads(
+                loss, fwd, acc = self._compute_loss_and_grads(
                     params, mb, scaler.scale, sub)
                 losses = loss[None]
             else:
                 # accumulate in fp32 over microbatches (reference knob
                 # gradient_accumulation_dtype); the accumulator carries the
                 # grad shardings so ZeRO-2+ keeps it scattered across steps
-                acc, losses = self._accumulate_grads(params, batch,
-                                                     scaler.scale, rng)
+                acc, losses, fwd = self._accumulate_grads(
+                    params, batch, scaler.scale, rng)
+                # collapse the [gas] axis: means throughout (act_absmax
+                # becomes a mean-of-maxes across microbatches)
+                fwd = jax.tree.map(lambda x: jnp.mean(x, axis=0), fwd)
             params, opt_state, scaler, metrics = self._apply_update(
-                params, opt_state, scaler, acc, step, gas)
+                params, opt_state, scaler, acc, step, gas, fwd_metrics=fwd)
             metrics["loss"] = jnp.mean(losses)
             return params, opt_state, scaler, metrics
 
@@ -685,6 +738,7 @@ class DeepSpeedTPUEngine:
         self.global_samples += int(self.config.train_batch_size)
         if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
             self.skipped_steps += 1
+        metrics = self._note_health(metrics)
         self._last_metrics = metrics
         self._close_step_span()
         self._write_monitor(metrics)
@@ -778,6 +832,7 @@ class DeepSpeedTPUEngine:
             self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self.fp16_enabled and int(jax.device_get(metrics["overflow"])):
             self.skipped_steps += 1
+        metrics = self._note_health(metrics)
         self._last_metrics = metrics
         loss = metrics["loss"]
         self.tput_timer.stop(sync=loss)
@@ -1132,6 +1187,19 @@ class DeepSpeedTPUEngine:
         # comm_exposed category can be carved out of train-step time
         telemetry.goodput_ledger.set_roofline(self._roofline_compute_s,
                                               self._roofline_comm_s)
+        # -- model-health taps (telemetry/health.py): stats are computed
+        # in-graph EVERY step behind a static build-time flag (identical
+        # program on- and off-cadence → zero retraces); ``every`` only
+        # gates the host-side fetch/publish below
+        hcfg = tcfg.health
+        self._health_enabled = bool(hcfg.enabled)
+        self._health_monitor = None
+        if hcfg.enabled:
+            from deepspeed_tpu.telemetry.health import HealthMonitor
+            self._health_monitor = HealthMonitor(
+                every=hcfg.every, max_layers=hcfg.max_layers,
+                z_threshold=hcfg.z_threshold,
+                dead_fraction=hcfg.dead_fraction)
         # -- resilience: arm the deterministic fault injector from config
         # (env DSTPU_FAULT_PLAN is merged inside arm()) and push the
         # checkpoint IO retry knobs into the store module
@@ -1292,6 +1360,25 @@ class DeepSpeedTPUEngine:
         except Exception:
             return None
 
+    def _note_health(self, metrics):
+        """Route the in-graph model-health stats (vector-valued, computed
+        every step — telemetry/health.py) out of the step metrics and into
+        the HealthMonitor's cadence gate. Off-cadence steps drop the device
+        refs unfetched — no transfer, no sync; the scalar metrics left in
+        the dict keep flowing to the monitor/flight-recorder paths."""
+        if not isinstance(metrics, dict):
+            return metrics
+        health = metrics.pop("health", None)
+        hm = getattr(self, "_health_monitor", None)
+        if hm is None or (health is None and "aux_loss" not in metrics):
+            return metrics
+        try:
+            hm.note(self.global_steps, health,
+                    aux_loss=metrics.get("aux_loss"))
+        except Exception as e:                       # noqa: BLE001
+            logger.warning(f"health telemetry publish failed: {e}")
+        return metrics
+
     def _write_monitor(self, metrics: Dict[str, jax.Array]) -> None:
         # every step is RECORDED (the reference writes monitor events each
         # step when enabled, engine.py:2822 — decimating would drop TB/W&B
@@ -1323,6 +1410,13 @@ class DeepSpeedTPUEngine:
                 step,
                 loss=vals.get("loss"),
                 grad_norm=vals.get("grad_norm"))
+            # MoE load-balancing pressure as a first-class gauge, visible
+            # without the full health cadence (rides the same fetch)
+            if "aux_loss" in vals:
+                telemetry.registry.gauge(
+                    "train/aux_loss",
+                    help="MoE load-balancing auxiliary loss").set(
+                    float(vals["aux_loss"]))
         # registry snapshot rides the same flush cadence (MFU, step-time
         # histogram aggregates, mem/* watermarks, comm/* counters); the
         # metric history + SLO evaluation share the same single lock pass

@@ -299,6 +299,17 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             return moe_fn(c, p, x, rts_key=lk)
         return mf
 
+    # Model-health taps (telemetry/health.py): bake the static flag into
+    # a REPLACED config instance used only by this loss_fn's forward —
+    # init/specs/pipeline/param_stream/inference keep the untapped
+    # dec_cfg and its 2-tuple forward contract. The flag never flips
+    # mid-run, so every step traces the identical program.
+    _hcfg = ds_cfg.telemetry.health
+    health_taps = bool(_hcfg.enabled and _hcfg.activations)
+    if health_taps:
+        import dataclasses
+        taps_cfg = dataclasses.replace(dec_cfg, health_taps=True)
+
     # ZeRO-3 chunked-overlap plan, filled in by the engine (which owns
     # the mesh + abstract params) via ModelSpec.configure_overlap; while
     # unset, loss_fn runs the plain monolithic layer scan
@@ -322,16 +333,32 @@ def decoder_model_spec(dec_cfg: DecoderConfig,
             if "token_type_ids" in batch:
                 enc["token_type_ids"] = batch["token_type_ids"]
         plan = _ovl["plan"]
-        hidden, aux = transformer.forward_hidden(
-            dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
-            remat_policy=remat,
-            layer_loop=plan.layer_loop if plan is not None else None,
-            **enc)
+        hstats = None
+        if health_taps:
+            hidden, aux, hstats = transformer.forward_hidden(
+                taps_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
+                remat_policy=remat,
+                layer_loop=plan.layer_loop if plan is not None else None,
+                **enc)
+        else:
+            hidden, aux = transformer.forward_hidden(
+                dec_cfg, params, tokens, attn_fn=attn_fn, moe_fn=mf,
+                remat_policy=remat,
+                layer_loop=plan.layer_loop if plan is not None else None,
+                **enc)
         loss = transformer.chunked_cross_entropy(dec_cfg, params, hidden,
                                                  labels,
                                                  budget_bytes=ce_budget,
                                                  logits_dtype=ce_dtype)
-        return loss + aux if moe_fn is not None else loss
+        total = loss + aux if moe_fn is not None else loss
+        metrics = {}
+        if moe_fn is not None:
+            # satellite: surface load-balancing pressure as
+            # train/aux_loss even without the health cadence
+            metrics["aux_loss"] = aux
+        if hstats is not None:
+            metrics["health"] = hstats
+        return (total, metrics) if metrics else total
 
     tp = ds_cfg.tensor_parallel.enabled
     mics = int(ds_cfg.zero_optimization.mics_shard_size or 0) > 1

@@ -345,13 +345,13 @@ class OverlapPlan:
         for k in range(min(d + 1, n)):
             window.append(slice_tree(layers, k))
             pending.append(k)
-        aux_parts: List[jax.Array] = []
+        aux_parts: List[Pytree] = []
         for k in range(n):
             chunk = window.pop(0)
             pending.pop(0)
             ek = slice_tree(extra, k) if extra is not None else None
             x, aux = chunk_fn(x, chunk, ek)
-            aux_parts.append(jnp.atleast_1d(aux))
+            aux_parts.append(jax.tree.map(jnp.atleast_1d, aux))
             nxt = k + d + 1
             if nxt < n:
                 # tie the NEXT prefetch slice to the activation just
@@ -360,7 +360,10 @@ class OverlapPlan:
                 nchunk, x = _opt_barrier((slice_tree(layers, nxt), x))
                 window.append(nchunk)
                 pending.append(nxt)
-        return x, jnp.concatenate(aux_parts)
+        # aux may be a pytree (health taps' per-layer stats dict), so
+        # concatenate leaf-wise along the stacked layer axis
+        return x, jax.tree.map(
+            lambda *parts: jnp.concatenate(parts), *aux_parts)
 
     def _record_trace_comms(self) -> None:
         """Trace-time comm accounting for the chunked collectives: the

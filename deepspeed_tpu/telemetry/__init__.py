@@ -28,7 +28,11 @@ run die, hang, or slow down":
 - :mod:`~deepspeed_tpu.telemetry.anomaly` — non-finite / loss-spike /
   grad-outlier / step-time-regression flags on the step stream;
 - :mod:`~deepspeed_tpu.telemetry.doctor` — the ``dstpu-doctor`` CLI
-  that turns per-host black boxes into a health report.
+  that turns per-host black boxes into a health report;
+- :mod:`~deepspeed_tpu.telemetry.health` — in-graph model-health taps
+  (per-layer training dynamics, MoE expert load) published as
+  ``health/*`` gauges, with the per-layer anomaly localizer and the
+  ``dstpu-health`` renderer.
 
 The compile-time side (PR 5) answers "where was this step ALWAYS going
 to spend its FLOPs, bytes, and HBM" before it runs:
@@ -76,6 +80,7 @@ from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
     FlightRecorder, flight_recorder, load_dump)
 from deepspeed_tpu.telemetry.goodput import (GoodputLedger,  # noqa: F401
                                              goodput_ledger)
+from deepspeed_tpu.telemetry.health import HealthMonitor  # noqa: F401
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               Histogram, MetricsRegistry,
                                               registry)
@@ -108,7 +113,7 @@ __all__ = ["tracer", "Tracer", "registry", "MetricsRegistry", "Counter",
            "resolve_metric", "windowed", "Objective", "SLOEngine",
            "engine_from_config", "evaluate_history", "reqtrace",
            "ReqTrace", "TraceContext", "critical_path",
-           "goodput_ledger", "GoodputLedger"]
+           "goodput_ledger", "GoodputLedger", "HealthMonitor"]
 
 
 def configure(telemetry_config) -> None:

@@ -30,6 +30,18 @@ LOSS_SPIKE_FACTOR = 2.0
 STEP_TIME_REGRESSION_FACTOR = 2.5
 #: warm-up samples before spike/z-score/regression detectors arm
 MIN_SAMPLES = 8
+#: relative epsilon floor on the window std: a (near-)constant window
+#: otherwise makes the z-score degenerate — float jitter over a ~0 std
+#: flags noise as an anomaly (div-by-~0)
+STD_EPS_REL = 1e-6
+#: |z| of one layer's stat against ITS OWN rolling window above which
+#: the localizer flags ``anomaly/layer_divergence``
+LAYER_Z_THRESHOLD = 6.0
+#: an expert whose windowed mean load sits below this fraction of the
+#: uniform share (1/E) counts as dead → ``anomaly/expert_collapse``
+DEAD_EXPERT_FRACTION = 0.1
+#: health-cadence samples before the expert-collapse detector arms
+EXPERT_MIN_SAMPLES = 4
 
 
 def first_flagged_path(flags: Any) -> Optional[str]:
@@ -63,17 +75,30 @@ class AnomalyDetector:
         self._step_time: deque = deque(maxlen=window)
         self.anomalies: List[Dict[str, Any]] = []
         self._max_anomalies = 256
+        # per-layer/per-expert localizer state (telemetry/health.py
+        # feeds these at the health cadence): one rolling window per
+        # (stat, layer) and per expert
+        self._layer_windows: Dict[str, List[deque]] = {}
+        self._expert_load: List[deque] = []
+        #: worst per-layer z seen on the LAST observe_layers call (set
+        #: even below threshold — the health/worst_layer* gauges)
+        self.last_layer_score: Optional[Dict[str, Any]] = None
+        #: most recent flags, for latching into gauges / dstpu-top
+        self.last_layer_divergence: Optional[Dict[str, Any]] = None
+        self.last_expert_collapse: Optional[Dict[str, Any]] = None
 
     # -- core ----------------------------------------------------------------
 
     def _flag(self, kind: str, step: Optional[int], value: Any = None,
-              detail: str = "") -> Dict[str, Any]:
+              detail: str = "", **extra: Any) -> Dict[str, Any]:
         rec = {"kind": kind, "step": step, "ts": time.time()}
         if value is not None:
             rec["value"] = value if isinstance(value, (int, float, str)) \
                 else repr(value)
         if detail:
             rec["detail"] = detail
+        if extra:   # localizer coordinates (layer=/z= or expert=/load=)
+            rec.update(extra)
         with self._lock:
             self.anomalies.append(rec)
             del self.anomalies[:-self._max_anomalies]
@@ -94,7 +119,7 @@ class AnomalyDetector:
                 flight_recorder
             flight_recorder.record_event("anomaly", anomaly=kind, step=step,
                                          value=rec.get("value"),
-                                         detail=detail or None)
+                                         detail=detail or None, **extra)
         except Exception:
             pass
         return rec
@@ -107,7 +132,11 @@ class AnomalyDetector:
         mean = sum(vals) / len(vals)
         var = sum((v - mean) ** 2 for v in vals) / len(vals)
         med = sorted(vals)[len(vals) // 2]
-        return {"mean": mean, "std": math.sqrt(var), "median": med}
+        # epsilon floor (relative to the window's own scale): a
+        # constant window otherwise yields std≈0 and the z-score
+        # divides float jitter by ~0 — see STD_EPS_REL
+        std = max(math.sqrt(var), STD_EPS_REL * max(abs(mean), 1.0))
+        return {"mean": mean, "std": std, "median": med}
 
     # -- ingestion ------------------------------------------------------------
 
@@ -154,6 +183,87 @@ class AnomalyDetector:
             self._step_time.append(step_time_ms)
         return out
 
+    def observe_layers(self, step: int,
+                       grad_norms: Optional[Any] = None,
+                       act_rms: Optional[Any] = None,
+                       act_absmax: Optional[Any] = None,
+                       z_threshold: Optional[float] = None
+                       ) -> List[Dict[str, Any]]:
+        """Per-layer z-score localization over the health-cadence stat
+        vectors (telemetry/health.py): each layer is scored against ITS
+        OWN rolling window, so a layer whose grad norm jumps 6σ off its
+        own history flags ``layer_divergence`` naming the layer — even
+        while the global grad norm stays unremarkable. Baselines update
+        after the checks (a divergence doesn't instantly poison its own
+        window); ``last_layer_score`` always records the worst |z| seen
+        by this call, threshold or not, for the worst-layer gauges."""
+        out: List[Dict[str, Any]] = []
+        zt = LAYER_Z_THRESHOLD if z_threshold is None else float(z_threshold)
+        worst = None
+        for stat, series in (("grad_norm", grad_norms),
+                             ("act_rms", act_rms),
+                             ("act_absmax", act_absmax)):
+            if series is None:
+                continue
+            wins = self._layer_windows.setdefault(stat, [])
+            while len(wins) < len(series):
+                wins.append(deque(maxlen=DEFAULT_WINDOW))
+            for i, v in enumerate(series):
+                v = float(v)
+                win = wins[i]
+                if math.isfinite(v):   # nonfinite is the global check's job
+                    s = self._stats(win)
+                    if s:
+                        z = (v - s["mean"]) / s["std"]
+                        if worst is None or abs(z) > abs(worst["z"]):
+                            worst = {"layer": i, "stat": stat,
+                                     "z": z, "value": v, "step": step}
+                        if abs(z) > zt:
+                            out.append(self._flag(
+                                "layer_divergence", step, v,
+                                f"layer {i} {stat} z={z:.1f} "
+                                f"(window mean {s['mean']:.4g})",
+                                layer=i, stat=stat, z=round(z, 2)))
+                win.append(v)
+        if worst is not None:
+            self.last_layer_score = worst
+        if out:
+            self.last_layer_divergence = out[-1]
+        return out
+
+    def observe_experts(self, step: int, load: Any,
+                        dead_fraction: Optional[float] = None
+                        ) -> List[Dict[str, Any]]:
+        """Expert-collapse localization over the per-expert load
+        fractions: an expert whose WINDOWED MEAN load sits below
+        ``dead_fraction`` of the uniform share 1/E — persistently, not a
+        one-cadence dip — flags ``expert_collapse`` naming the expert."""
+        out: List[Dict[str, Any]] = []
+        e = len(load)
+        if not e:
+            return out
+        df = DEAD_EXPERT_FRACTION if dead_fraction is None \
+            else float(dead_fraction)
+        thr = df / e
+        while len(self._expert_load) < e:
+            self._expert_load.append(deque(maxlen=DEFAULT_WINDOW))
+        for i, v in enumerate(load):
+            v = float(v)
+            win = self._expert_load[i]
+            win.append(v)
+            if len(win) < EXPERT_MIN_SAMPLES:
+                continue
+            m = sum(win) / len(win)
+            if m < thr:
+                out.append(self._flag(
+                    "expert_collapse", step, m,
+                    f"expert {i} windowed load {m:.4f} < {thr:.4f} "
+                    f"({df:.0%} of uniform 1/{e})",
+                    expert=i, load=round(m, 6)))
+        if out:
+            self.last_expert_collapse = out[-1]
+        return out
+
     def report_nonfinite(self, step: int, leaf_path: Optional[str],
                          what: str = "grads") -> Dict[str, Any]:
         """Record a non-finite pytree hit from the engine's scoped check,
@@ -178,6 +288,11 @@ class AnomalyDetector:
             self._loss.clear()
             self._grad_norm.clear()
             self._step_time.clear()
+            self._layer_windows.clear()
+            del self._expert_load[:]
+            self.last_layer_score = None
+            self.last_layer_divergence = None
+            self.last_expert_collapse = None
 
 
 #: process-wide anomaly detector

@@ -258,6 +258,13 @@ def analyze(dumps: List[Dict[str, Any]],
     timeline.sort(key=lambda e: (e.get("ts", 0.0), e.get("step") or 0))
     nonfinite = [e for e in timeline
                  if str(e.get("anomaly", "")).startswith("nonfinite")]
+    # model-health localizer flags (telemetry/health.py → anomaly.py):
+    # carry the layer/expert coordinates so the verdict can NAME the
+    # diverged component, not just count anomalies
+    layer_div = [e for e in timeline
+                 if e.get("anomaly") == "layer_divergence"]
+    expert_col = [e for e in timeline
+                  if e.get("anomaly") == "expert_collapse"]
 
     # -- goodput: worst ledger fraction across reporting hosts; below
     # LOW_GOODPUT_FRACTION the verdict names the dominant badput
@@ -306,6 +313,23 @@ def analyze(dumps: List[Dict[str, Any]],
         e = nonfinite[0]
         verdict = (f"NON-FINITE values from step {e.get('step')} on "
                    f"{e['host']}: {e.get('detail') or e.get('anomaly')}")
+    elif layer_div:
+        e = layer_div[0]
+        z = e.get("z")
+        verdict = (f"LAYER DIVERGENCE on {e['host']}: layer "
+                   f"{e.get('layer')} {e.get('stat', 'grad_norm')} "
+                   f"z={z:+.1f} from step {e.get('step')} "
+                   f"({len(layer_div)} flag(s))"
+                   if isinstance(z, (int, float)) else
+                   f"LAYER DIVERGENCE on {e['host']}: layer "
+                   f"{e.get('layer')} from step {e.get('step')}")
+    elif expert_col:
+        e = expert_col[0]
+        ld = e.get("load")
+        verdict = (f"EXPERT COLLAPSE on {e['host']}: expert "
+                   f"{e.get('expert')} windowed load "
+                   f"{ld if ld is not None else '?'} from step "
+                   f"{e.get('step')} ({len(expert_col)} flag(s))")
     elif slo_open:
         e = slo_open[0]
         verdict = (f"SLO BREACH on {e['host']}: objective "
@@ -345,6 +369,8 @@ def analyze(dumps: List[Dict[str, Any]],
 
     return {"hosts": hosts, "straggler": straggler, "stalled": stalled,
             "bandwidth": bandwidth, "anomalies": timeline,
+            "model_health": {"layer_divergence": layer_div,
+                             "expert_collapse": expert_col},
             "storms": storms, "world": world, "verdict": verdict,
             "slo": {"timeline": slo_timeline, "open": slo_open},
             "recovery_timeline": recovery_timeline,
@@ -446,6 +472,20 @@ def render(report: Dict[str, Any]) -> str:
                        f"burn={e.get('burn_fast')}x")
         if len(slo["timeline"]) > 50:
             out.append(f"  ... {len(slo['timeline']) - 50} more")
+    mh = report.get("model_health") or {}
+    if mh.get("layer_divergence") or mh.get("expert_collapse"):
+        out.append("")
+        out.append("model health (per-layer z-score localizer):")
+        for e in (mh.get("layer_divergence") or [])[:20]:
+            z = e.get("z")
+            zs = f"z={z:+.1f}" if isinstance(z, (int, float)) else ""
+            out.append(f"  step {e.get('step')!s:>8} {e['host']:<24}"
+                       f"layer {e.get('layer')!s:<6}"
+                       f"{e.get('stat', 'grad_norm'):<12}{zs}")
+        for e in (mh.get("expert_collapse") or [])[:20]:
+            out.append(f"  step {e.get('step')!s:>8} {e['host']:<24}"
+                       f"expert {e.get('expert')!s:<5}"
+                       f"windowed load {e.get('load')}")
     if report["storms"]:
         out.append("")
         out.append(f"recompile storms: {', '.join(report['storms'])}")

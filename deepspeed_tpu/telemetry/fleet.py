@@ -215,6 +215,7 @@ class HostSample:
             "autoscale": autoscale_targets(m),
             "kvtier": kvtier_state(m),
             "exemplars": latency_exemplars(m),
+            "health": health_state(m),
             "goodput_pct": None if gp is None else
             100.0 * gp["fraction"],
             "goodput": gp,
@@ -248,6 +249,26 @@ def kvtier_state(metrics: Dict[str, Any]) -> Optional[Dict[str, float]]:
                         ("hits", "kvtier_hits"),
                         ("spills", "kvtier_spills"),
                         ("adopts", "kvtier_adopts")):
+        v = metrics.get(name)
+        if isinstance(v, (int, float)):
+            out[short] = float(v)
+    return out or None
+
+
+def health_state(metrics: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """Model-health localizer state from a host's parsed exposition
+    (the ``health_*`` gauges telemetry/health.py publishes). Reported
+    only while the anomaly latch is up — a healthy host stays one line
+    in the table. None when health telemetry is off or quiet."""
+    flag = metrics.get("health_anomaly")
+    if not isinstance(flag, (int, float)) or flag <= 0:
+        return None
+    out = {}
+    for short, name in (("layer", "health_worst_layer"),
+                        ("z", "health_worst_layer_z"),
+                        ("dead", "health_dead_experts"),
+                        ("expert", "health_worst_expert"),
+                        ("load", "health_worst_expert_load")):
         v = metrics.get(name)
         if isinstance(v, (int, float)):
             out[short] = float(v)
@@ -393,6 +414,18 @@ def rows_from_history(paths: List[str],
             gp = {"fraction": float(gfrac), "badput": badput,
                   "dominant_badput": dominant,
                   "dominant_badput_s": badput.get(dominant, 0.0)}
+        health = None
+        if metric(("health/anomaly",)):
+            health = {}
+            for short, name in (("layer", "health/worst_layer"),
+                                ("z", "health/worst_layer_z"),
+                                ("dead", "health/dead_experts"),
+                                ("expert", "health/worst_expert"),
+                                ("load", "health/worst_expert_load")):
+                v = metric((name,))
+                if v is not None:
+                    health[short] = float(v)
+            health = health or None
         rows.append({
             "host": host,
             "status": "degraded" if breached else "ok",
@@ -408,6 +441,7 @@ def rows_from_history(paths: List[str],
             "tok_rate": rate(H_TOKENS),
             "burn": metric(H_BURN),
             "stale_s": max(0.0, now - last.get("ts", now)),
+            "health": health,
             "goodput_pct": None if gp is None else
             100.0 * gp["fraction"],
             "goodput": gp,
@@ -481,6 +515,19 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
                    if isinstance(e.get("value"), (int, float)) else "")
                 for k, e in r["exemplars"].items())
             lines.append(f"    └─ tail exemplars: {pairs}")
+        h = r.get("health")
+        if h:
+            bits = []
+            if "layer" in h:
+                bits.append(f"worst layer {h['layer']:.0f}"
+                            + (f" z={h['z']:+.1f}" if "z" in h else ""))
+            if h.get("dead"):
+                bits.append(
+                    f"dead experts {h['dead']:.0f}"
+                    + (f" (worst {h['expert']:.0f}@{h['load']:.4f})"
+                       if "expert" in h else ""))
+            if bits:
+                lines.append("    └─ health: " + ", ".join(bits))
         gp = r.get("goodput")
         if gp and gp.get("dominant_badput"):
             lines.append(f"    └─ badput: dominant "

@@ -1,0 +1,521 @@
+"""deepspeed_tpu.telemetry.health — model-health observability: on-device
+per-layer training dynamics + MoE expert-load telemetry.
+
+The fused train step computes health statistics IN-GRAPH every step when
+``telemetry.health.enabled`` is set (per-layer gradient/parameter/update
+norms from the optimizer side, activation RMS/absmax and MoE router
+load/entropy from the forward's layer scan — all static-flag branches
+baked at trace time, so on- and off-cadence steps execute the identical
+program and nothing ever retraces). The engine hands the device arrays to
+:class:`HealthMonitor.note` every step; off-cadence steps drop the refs
+without any host transfer, and every ``telemetry.health.every``-th step
+does ONE batched ``jax.device_get`` and publishes:
+
+- ``health/layer/{i}/*`` per-layer gauges (grad_norm, param_norm,
+  update_ratio, act_rms, act_absmax, aux_loss);
+- ``health/expert/{e}/load`` + routing aggregates (entropy, dead count);
+- worst-layer / worst-expert + the latched ``health/anomaly`` flag that
+  dstpu-top renders as a per-host health sub-line.
+
+The same host-side vectors feed the per-layer z-score localizer
+(:meth:`AnomalyDetector.observe_layers` / ``observe_experts``), which
+names WHICH layer or expert diverged — ``anomaly/layer_divergence`` /
+``anomaly/expert_collapse`` flags that latch into the flight-recorder
+black box and surface as dstpu-doctor LAYER DIVERGENCE / EXPERT COLLAPSE
+verdicts.
+
+``bin/dstpu-health`` renders the history offline (per-layer sparkline /
+heatmap table over metric-history JSONL), live (``--watch`` over a
+``/metrics`` endpoint), and self-checks the whole chain (``--selftest``:
+a seeded divergence drill — one layer's grads scaled, one expert starved
+— asserting the localizer and the doctor name exactly them).
+"""
+
+import argparse
+import json
+import math
+import re
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.utils.logging import logger
+
+#: per-layer stat keys the engine/forward emit, in catalog order; the
+#: vector for each becomes ``health/layer/{i}/<key>`` gauges
+PER_LAYER_KEYS = ("grad_norm", "param_norm", "update_ratio",
+                  "act_rms", "act_absmax", "aux_loss")
+
+#: every ``health/*`` stat name this module publishes — linted by
+#: tools/check_metric_names.py against docs/observability.md (mirrors
+#: the fault-kind / goodput-category catalogs): an undocumented health
+#: stat is a gauge nobody can interpret from the runbook
+HEALTH_STATS = (
+    "health/layer/{i}/grad_norm",
+    "health/layer/{i}/param_norm",
+    "health/layer/{i}/update_ratio",
+    "health/layer/{i}/act_rms",
+    "health/layer/{i}/act_absmax",
+    "health/layer/{i}/aux_loss",
+    "health/expert/{e}/load",
+    "health/router_entropy",
+    "health/dead_experts",
+    "health/aux_loss",
+    "health/layers",
+    "health/worst_layer",
+    "health/worst_layer_z",
+    "health/worst_expert",
+    "health/worst_expert_load",
+    "health/anomaly",
+)
+
+#: publish cadences the ``health/anomaly`` flag stays latched after the
+#: last localizer hit (so a scrape/top poll between cadences still
+#: sees it)
+LATCH_CADENCES = 4
+
+#: default fetch/publish cadence (steps) when unconfigured
+DEFAULT_EVERY = 50
+
+_LAYER_RE = re.compile(r"^health_layer_(\d+)_([a-z0-9_]+)$")
+_EXPERT_RE = re.compile(r"^health_expert_(\d+)_load$")
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+class HealthMonitor:
+    """Engine-side cadence gate + publisher.
+
+    The engine calls :meth:`note` EVERY step with the device-resident
+    stat pytree the jitted step returned; the monitor drops off-cadence
+    refs unfetched (zero extra host round-trips) and on cadence performs
+    one batched transfer, publishes the ``health/*`` gauges, and feeds
+    the anomaly localizer.
+    """
+
+    def __init__(self, every: int = DEFAULT_EVERY, max_layers: int = 0,
+                 z_threshold: Optional[float] = None,
+                 dead_fraction: Optional[float] = None,
+                 detector: Optional[Any] = None):
+        self.every = max(1, int(every))
+        self.max_layers = max(0, int(max_layers))
+        self.z_threshold = z_threshold
+        self.dead_fraction = dead_fraction
+        self._detector = detector
+        self._latch = 0
+        #: last published host-side payload (tests / debugging)
+        self.last: Optional[Dict[str, Any]] = None
+
+    @property
+    def detector(self):
+        if self._detector is None:
+            from deepspeed_tpu.telemetry.anomaly import anomaly_detector
+            self._detector = anomaly_detector
+        return self._detector
+
+    # -- engine hook ---------------------------------------------------------
+
+    def note(self, step: int, stats: Optional[Dict[str, Any]] = None,
+             aux_loss: Optional[Any] = None) -> Optional[List[Dict[str, Any]]]:
+        """Per-step hook. ``stats``/``aux_loss`` are device arrays (or
+        None); only every ``self.every``-th step transfers and publishes.
+        Returns the localizer flags raised by this publish (None when the
+        step was off-cadence)."""
+        if stats is None and aux_loss is None:
+            return None
+        if step % self.every:
+            return None
+        try:
+            import jax
+            stats, aux_loss = jax.device_get((stats, aux_loss))
+        except Exception:
+            logger.warning("health: device fetch failed", exc_info=True)
+            return None
+        return self.publish(step, stats, aux_loss=aux_loss)
+
+    # -- publish -------------------------------------------------------------
+
+    def publish(self, step: int, stats: Optional[Dict[str, Any]],
+                aux_loss: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Publish HOST-side stats as gauges + run the localizer. Split
+        from :meth:`note` so drills/tests can inject synthetic vectors
+        without a device in the loop."""
+        import numpy as np
+        from deepspeed_tpu.telemetry.registry import registry
+
+        def g(name: str, v: float) -> None:
+            registry.gauge(name).set(float(v))
+
+        stats = dict(stats or {})
+        if aux_loss is not None and np.ndim(aux_loss) == 0:
+            g("train/aux_loss", aux_loss)
+            g("health/aux_loss", aux_loss)
+
+        per_layer: Dict[str, Any] = {}
+        layers = 0
+        for k in PER_LAYER_KEYS:
+            v = stats.get(k)
+            if v is None:
+                continue
+            arr = np.asarray(v, dtype=np.float64).reshape(-1)
+            per_layer[k] = arr
+            layers = max(layers, len(arr))
+        if layers:
+            g("health/layers", layers)
+            cap = self.max_layers or layers
+            for k, arr in per_layer.items():
+                for i in range(min(cap, len(arr))):
+                    g(f"health/layer/{i}/{k}", arr[i])
+
+        load = None
+        el = stats.get("expert_load")
+        if el is not None:
+            el = np.asarray(el, dtype=np.float64)
+            # forward taps stack [L, E] — average the MoE layers for the
+            # per-expert gauges; the localizer sees the same aggregate
+            load = el.reshape(-1, el.shape[-1]).mean(axis=0) \
+                if el.ndim > 1 else el
+            for i, v in enumerate(load):
+                g(f"health/expert/{i}/load", v)
+            from deepspeed_tpu.telemetry.anomaly import DEAD_EXPERT_FRACTION
+            df = self.dead_fraction if self.dead_fraction is not None \
+                else DEAD_EXPERT_FRACTION
+            dead = int((load < df / max(len(load), 1)).sum())
+            g("health/dead_experts", dead)
+            wi = int(load.argmin())
+            g("health/worst_expert", wi)
+            g("health/worst_expert_load", load[wi])
+        re_ = stats.get("router_entropy")
+        if re_ is not None:
+            g("health/router_entropy", float(np.mean(re_)))
+
+        flags: List[Dict[str, Any]] = []
+        det = self.detector
+        if det is not None:
+            if any(k in per_layer for k in ("grad_norm", "act_rms",
+                                            "act_absmax")):
+                flags += det.observe_layers(
+                    step, grad_norms=per_layer.get("grad_norm"),
+                    act_rms=per_layer.get("act_rms"),
+                    act_absmax=per_layer.get("act_absmax"),
+                    z_threshold=self.z_threshold)
+            if load is not None and len(load):
+                flags += det.observe_experts(
+                    step, load, dead_fraction=self.dead_fraction)
+            ws = getattr(det, "last_layer_score", None)
+            if ws:
+                g("health/worst_layer", ws["layer"])
+                g("health/worst_layer_z", ws["z"])
+        if flags:
+            self._latch = LATCH_CADENCES
+        g("health/anomaly", 1.0 if self._latch > 0 else 0.0)
+        if self._latch > 0:
+            self._latch -= 1
+        self.last = {"step": step, "layers": layers,
+                     "stats": {k: v.tolist() for k, v in per_layer.items()},
+                     "expert_load": None if load is None else load.tolist(),
+                     "flags": flags}
+        return flags
+
+
+# ---------------------------------------------------------------------------
+# Offline / live rendering (dstpu-health)
+# ---------------------------------------------------------------------------
+
+def _flatten(record: Dict[str, Any]) -> Dict[str, float]:
+    """History record → flat {prom_name: value} (same shape as a parsed
+    /metrics exposition), so one rendering path serves both modes."""
+    out: Dict[str, float] = {}
+    for k, v in record.get("m", {}).items():
+        if isinstance(v, (int, float)):
+            out[k.replace("/", "_")] = float(v)
+    return out
+
+
+def sparkline(vals: Sequence[float], width: int = 32) -> str:
+    """Unicode block sparkline, normalized over the series' own range."""
+    vals = [v for v in vals if v is not None and math.isfinite(v)]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # downsample: mean over equal chunks keeps spikes visible enough
+        # while the table stays one terminal line per layer
+        chunk = len(vals) / width
+        vals = [sum(vals[int(j * chunk):max(int(j * chunk) + 1,
+                                            int((j + 1) * chunk))])
+                / max(1, len(vals[int(j * chunk):max(int(j * chunk) + 1,
+                                                     int((j + 1) * chunk))]))
+                for j in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _BLOCKS[0] * len(vals)
+    return "".join(_BLOCKS[min(len(_BLOCKS) - 1,
+                               int((v - lo) / span * len(_BLOCKS)))]
+                   for v in vals)
+
+
+def _series_z(series: List[float]) -> Optional[float]:
+    """z of the last sample against the rest of its own series (same
+    epsilon-floored convention as the online localizer)."""
+    head, last = series[:-1], series[-1]
+    head = [v for v in head if math.isfinite(v)]
+    if len(head) < 2 or not math.isfinite(last):
+        return None
+    mean = sum(head) / len(head)
+    var = sum((v - mean) ** 2 for v in head) / len(head)
+    std = max(math.sqrt(var), 1e-6 * max(abs(mean), 1.0))
+    return (last - mean) / std
+
+
+def report_from_frames(frames: List[Dict[str, float]],
+                       stat: str = "grad_norm") -> Dict[str, Any]:
+    """Flat metric frames (oldest first) → structured health report."""
+    layer_series: Dict[int, List[float]] = {}
+    expert_series: Dict[int, List[float]] = {}
+    for fr in frames:
+        for k, v in fr.items():
+            m = _LAYER_RE.match(k)
+            if m and m.group(2) == stat:
+                layer_series.setdefault(int(m.group(1)), []).append(v)
+                continue
+            m = _EXPERT_RE.match(k)
+            if m:
+                expert_series.setdefault(int(m.group(1)), []).append(v)
+    last = frames[-1] if frames else {}
+    layers = [{"layer": i, "series": s, "last": s[-1],
+               "z": _series_z(s)}
+              for i, s in sorted(layer_series.items())]
+    experts = [{"expert": i, "series": s, "last": s[-1]}
+               for i, s in sorted(expert_series.items())]
+    agg = {k: last.get("health_" + k)
+           for k in ("layers", "router_entropy", "dead_experts",
+                     "worst_layer", "worst_layer_z", "worst_expert",
+                     "worst_expert_load", "anomaly", "aux_loss")
+           if last.get("health_" + k) is not None}
+    return {"stat": stat, "frames": len(frames), "layers": layers,
+            "experts": experts, "aggregates": agg}
+
+
+def render_report(report: Dict[str, Any], width: int = 32) -> str:
+    out: List[str] = []
+    agg = report["aggregates"]
+    out.append(f"== dstpu-health · {report['stat']} · "
+               f"{report['frames']} sample(s) ==")
+    if agg:
+        bits = [f"{k}={agg[k]:.4g}" for k in
+                ("router_entropy", "dead_experts", "aux_loss") if k in agg]
+        if "worst_layer" in agg:
+            bits.append(f"worst_layer={int(agg['worst_layer'])} "
+                        f"(z={agg.get('worst_layer_z', 0.0):+.1f})")
+        if agg.get("anomaly"):
+            bits.append("ANOMALY LATCHED")
+        if bits:
+            out.append("  " + "  ".join(bits))
+    if not report["layers"]:
+        out.append(f"  (no health/layer/*/{report['stat']} samples — is "
+                   f"telemetry.health enabled and the cadence reached?)")
+    else:
+        out.append("")
+        out.append(f"  {'layer':>5}  {'history':<{width}}  "
+                   f"{'last':>10}  {'z':>6}")
+        for row in report["layers"]:
+            z = f"{row['z']:+.1f}" if row["z"] is not None else "-"
+            out.append(f"  {row['layer']:>5}  "
+                       f"{sparkline(row['series'], width):<{width}}  "
+                       f"{row['last']:>10.4g}  {z:>6}")
+    if report["experts"]:
+        out.append("")
+        out.append(f"  {'expert':>6}  {'load':<{width}}  {'last':>10}")
+        for row in report["experts"]:
+            out.append(f"  {row['expert']:>6}  "
+                       f"{sparkline(row['series'], width):<{width}}  "
+                       f"{row['last']:>10.4g}")
+    return "\n".join(out)
+
+
+def _fetch_frame(url: str, timeout: float = 5.0) -> Dict[str, float]:
+    from urllib.request import urlopen
+    from deepspeed_tpu.telemetry.fleet import parse_prometheus_text
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus_text(resp.read().decode("utf-8", "replace"))
+
+
+def watch(url: str, stat: str, interval: float, once: bool,
+          as_json: bool, max_frames: int = 64) -> int:
+    frames: deque = deque(maxlen=max_frames)
+    while True:
+        try:
+            frames.append(_fetch_frame(url))
+        except Exception as e:
+            print(f"dstpu-health: fetch {url} failed: {e}", file=sys.stderr)
+            if once:
+                return 2
+            time.sleep(interval)
+            continue
+        report = report_from_frames(list(frames), stat=stat)
+        if as_json:
+            print(json.dumps({k: v for k, v in report.items()
+                              if k != "layers"} |
+                             {"layers": [{k2: v2 for k2, v2 in r.items()
+                                          if k2 != "series"}
+                                         for r in report["layers"]]}))
+        else:
+            if not once:
+                print("\x1b[2J\x1b[H", end="")
+            print(render_report(report))
+        if once:
+            return 0
+        time.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
+# Selftest: the seeded divergence drill as a tier-1 smoke
+# ---------------------------------------------------------------------------
+
+def selftest() -> int:
+    """Synthetic end-to-end drill: 8 layers / 4 experts, layer 5's grad
+    norm scaled 100x late in the run, expert 2 starved throughout.
+    Passes iff the localizer names EXACTLY that layer and expert, the
+    gauges landed, dstpu-doctor's verdict names the layer, and the
+    offline renderer draws the table."""
+    import numpy as np
+    from deepspeed_tpu.telemetry.anomaly import AnomalyDetector
+    from deepspeed_tpu.telemetry.registry import registry
+    from deepspeed_tpu.telemetry import doctor
+
+    L, E, DIV_LAYER, DEAD_EXPERT = 8, 4, 5, 2
+    det = AnomalyDetector()
+    mon = HealthMonitor(every=1, detector=det)
+    frames: List[Dict[str, float]] = []
+    failures: List[str] = []
+
+    for step in range(1, 25):
+        base = np.array([0.01 * (1 + i) for i in range(L)])
+        # deterministic jitter: realistic non-constant windows
+        base = base * (1.0 + 0.001 * ((step * 7 + np.arange(L)) % 5 - 2))
+        if step >= 20:
+            base[DIV_LAYER] *= 100.0          # the seeded divergence
+        load = np.full(E, (1.0 - 0.001) / (E - 1))
+        load[DEAD_EXPERT] = 0.001             # the starved expert
+        stats = {"grad_norm": base, "param_norm": np.ones(L),
+                 "update_ratio": np.full(L, 1e-3),
+                 "act_rms": np.ones(L), "act_absmax": np.ones(L) * 3,
+                 "aux_loss": np.full(L, 0.01 / L),
+                 "expert_load": np.tile(load, (L, 1)),
+                 "router_entropy": np.full(L, 1.2)}
+        mon.publish(step, stats, aux_loss=0.01)
+        snap = registry.snapshot(interval=False)
+        frames.append({k.replace("/", "_"): v for k, v in snap.items()
+                       if k.startswith("health/")
+                       and isinstance(v, (int, float))})
+
+    div_layers = {a.get("layer") for a in det.anomalies
+                  if a["kind"] == "layer_divergence"}
+    dead_experts = {a.get("expert") for a in det.anomalies
+                    if a["kind"] == "expert_collapse"}
+    if div_layers != {DIV_LAYER}:
+        failures.append(f"localizer named layers {sorted(div_layers)}, "
+                        f"want exactly {{{DIV_LAYER}}}")
+    if dead_experts != {DEAD_EXPERT}:
+        failures.append(f"localizer named experts {sorted(dead_experts)}, "
+                        f"want exactly {{{DEAD_EXPERT}}}")
+
+    snap = registry.snapshot(interval=False)
+    for name in (f"health/layer/{DIV_LAYER}/grad_norm",
+                 f"health/expert/{DEAD_EXPERT}/load",
+                 "health/dead_experts", "health/worst_layer",
+                 "health/anomaly", "train/aux_loss"):
+        if name not in snap:
+            failures.append(f"gauge {name} never published")
+    if snap.get("health/anomaly") != 1.0:
+        failures.append("health/anomaly flag not latched after the drill")
+    if snap.get("health/worst_layer") != float(DIV_LAYER):
+        failures.append(f"health/worst_layer={snap.get('health/worst_layer')}"
+                        f", want {DIV_LAYER}")
+
+    events = [{**{k: v for k, v in rec.items() if k != "kind"},
+               "kind": "anomaly", "anomaly": rec["kind"]}
+              for rec in det.anomalies]
+    report = doctor.analyze([{"meta": {"hostname": "selftest"},
+                              "steps": [], "events": events}])
+    verdict = report["verdict"]
+    if not verdict.startswith("LAYER DIVERGENCE") or \
+            f"layer {DIV_LAYER}" not in verdict:
+        failures.append(f"doctor verdict doesn't name the layer: {verdict!r}")
+
+    table = render_report(report_from_frames(frames))
+    if f"{DIV_LAYER:>5}" not in table or "expert" not in table:
+        failures.append("renderer dropped the layer/expert tables")
+
+    print(f"dstpu-health selftest: drill over {L} layers / {E} experts, "
+          f"divergence seeded into layer {DIV_LAYER} @ step 20, expert "
+          f"{DEAD_EXPERT} starved")
+    print(f"  localizer: layer_divergence={sorted(div_layers)} "
+          f"expert_collapse={sorted(dead_experts)}")
+    print(f"  doctor: {verdict}")
+    for f in failures:
+        print(f"  FAIL: {f}")
+    print(f"dstpu-health selftest: "
+          f"{'FAILED' if failures else 'OK'}")
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-health",
+        description="Per-layer model-health view: sparkline/heatmap "
+                    "table over metric-history JSONL, live over /metrics "
+                    "(--watch), or the seeded-divergence selftest.")
+    ap.add_argument("history", nargs="*",
+                    help="metric-history JSONL file(s) "
+                         "(telemetry.history_file)")
+    ap.add_argument("--stat", default="grad_norm",
+                    choices=list(PER_LAYER_KEYS),
+                    help="per-layer stat to render (default grad_norm)")
+    ap.add_argument("--last", type=int, default=64, metavar="N",
+                    help="use the last N history records (default 64)")
+    ap.add_argument("--watch", metavar="URL", default=None,
+                    help="poll a /metrics endpoint (host:port or URL) "
+                         "and render live")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch poll seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="with --watch: render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the seeded divergence drill (tier-1 smoke)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.watch:
+        return watch(args.watch, args.stat, args.interval, args.once,
+                     args.json)
+    if not args.history:
+        ap.error("give history JSONL file(s), --watch URL, or --selftest")
+    from deepspeed_tpu.telemetry.timeseries import merge_records
+    records = merge_records(args.history)
+    if args.last > 0:
+        records = records[-args.last:]
+    frames = [_flatten(r) for r in records]
+    report = report_from_frames(frames, stat=args.stat)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

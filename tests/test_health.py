@@ -1,0 +1,249 @@
+"""Model-health observability tests (telemetry/health.py + the engine
+taps): in-graph stat publication, host-side cadence gating, the
+zero-retrace guarantee, the per-layer/per-expert anomaly localizer, the
+zero-variance epsilon-floor regression, the doctor verdicts, the
+dstpu-top sub-line, and the dstpu-health CLI selftest."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.telemetry import health
+from deepspeed_tpu.telemetry.anomaly import AnomalyDetector
+from deepspeed_tpu.telemetry.health import HealthMonitor
+
+
+# ----------------------------------------------------------- engine taps
+
+def test_engine_health_taps_publish_cadence_and_no_retrace(devices):
+    """Tiny MoE engine with health enabled: gauges land in the registry,
+    train/aux_loss is emitted, the monitor publishes only on-cadence,
+    and on/off-cadence steps trace to ONE identical program."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.telemetry import compile_monitor
+    from deepspeed_tpu.telemetry.anomaly import anomaly_detector
+    from deepspeed_tpu.telemetry.registry import registry
+
+    anomaly_detector.clear()
+    build_mesh(data=8)
+    model = mixtral_config("tiny", max_seq_len=64, vocab_size=256)
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "moe": {"enabled": True, "ep_size": 1,
+                        "num_experts": model.num_experts,
+                        "capacity_factor": 4.0},
+                "steps_per_print": 1000,
+                "telemetry": {"health": {"enabled": True, "every": 2}}},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32), dtype=np.int32)}
+    before = compile_monitor.retrace_count("engine/fused_step")
+    published = set()
+    for _ in range(5):
+        loss = float(engine.train_batch(iter([batch])))
+        assert np.isfinite(loss)
+        if engine._health_monitor.last is not None:
+            published.add(engine._health_monitor.last["step"])
+    # cadence: global_steps 1..5 with every=2 → published at 2 and 4 only
+    assert published == {2, 4}
+    # static flag: the off-cadence steps ran the IDENTICAL program
+    assert compile_monitor.retrace_count("engine/fused_step") - before == 1
+    snap = registry.snapshot(interval=False)
+    for name in ("health/layer/0/grad_norm", "health/layer/0/param_norm",
+                 "health/layer/0/update_ratio", "health/layer/0/act_rms",
+                 "health/layer/0/act_absmax", "health/expert/0/load",
+                 "health/router_entropy", "health/dead_experts",
+                 "health/layers", "health/anomaly", "health/aux_loss",
+                 "train/aux_loss"):
+        assert isinstance(snap.get(name), float), f"missing gauge {name}"
+    assert snap["health/layers"] == float(model.num_layers)
+    # per-expert loads are fractions of dispatched tokens
+    loads = [snap[f"health/expert/{e}/load"]
+             for e in range(model.num_experts)]
+    assert all(0.0 <= v <= 1.0 for v in loads)
+    # the step metrics handed to the monitor/flight paths stay scalar
+    assert "health" not in engine._last_metrics
+
+
+def test_engine_health_disabled_unchanged(devices):
+    """With telemetry.health off the step metrics carry no health entry
+    (and no aux_loss key on a dense model) — the taps are strictly
+    opt-in."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import llama3_config
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    build_mesh(data=8)
+    model = llama3_config("tiny", max_seq_len=64, tie_embeddings=True)
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000},
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.vocab_size, (8, 32), dtype=np.int32)}
+    loss = float(engine.train_batch(iter([batch])))
+    assert np.isfinite(loss)
+    assert engine._health_monitor is None
+    assert "health" not in engine._last_metrics
+    assert "aux_loss" not in engine._last_metrics
+
+
+# ------------------------------------------- cadence gate (monitor unit)
+
+def test_health_monitor_note_gates_on_cadence():
+    det = AnomalyDetector()
+    mon = HealthMonitor(every=3, detector=det)
+    published = []
+    for step in range(1, 10):
+        out = mon.note(step, {"grad_norm": np.ones(2)}, aux_loss=0.5)
+        if out is not None:
+            published.append(step)
+    assert published == [3, 6, 9]
+    # nothing to publish → no fetch, no publish, even on-cadence
+    assert mon.note(12, None, aux_loss=None) is None
+
+
+# ------------------------------------- zero-variance epsilon-floor fix
+
+def test_stats_epsilon_floor_constant_window_no_false_flag():
+    """Regression: a perfectly constant stat window used to yield std≈0,
+    so the next sample's float jitter z-scored to ±inf and flagged. The
+    relative epsilon floor keeps jitter silent while a genuine
+    divergence still flags."""
+    det = AnomalyDetector()
+    for step in range(20):
+        assert det.observe_layers(step, grad_norms=[1.0, 0.5]) == []
+    # float jitter over the constant window: must NOT flag
+    assert det.observe_layers(20, grad_norms=[1.0 + 1e-9, 0.5]) == []
+    # a genuine 50x divergence on layer 0: must flag exactly layer 0
+    flags = det.observe_layers(21, grad_norms=[50.0, 0.5])
+    assert [f["kind"] for f in flags] == ["layer_divergence"]
+    assert flags[0]["layer"] == 0 and flags[0]["stat"] == "grad_norm"
+    assert abs(flags[0]["z"]) > 6.0
+
+
+def test_observe_grad_norm_constant_window_no_false_flag():
+    det = AnomalyDetector()
+    for s in range(16):
+        assert det.observe(s, grad_norm=1.0) == []
+    assert det.observe(16, grad_norm=1.0 + 1e-7) == []
+    out = det.observe(17, grad_norm=2.0)
+    assert [f["kind"] for f in out] == ["grad_norm_outlier"]
+
+
+# --------------------------------------------------- seeded drill + doctor
+
+def test_seeded_drill_localizes_layer_and_expert_and_doctor_names_them():
+    """Scale one layer's grad norms 100x and starve one expert: the
+    localizer must name exactly those coordinates, the anomaly latch
+    must rise, and dstpu-doctor must render the LAYER DIVERGENCE verdict
+    naming the layer with its z-score."""
+    from deepspeed_tpu.telemetry import doctor
+    from deepspeed_tpu.telemetry.registry import registry
+
+    L, E, DIV_LAYER, DEAD_EXPERT = 6, 4, 3, 1
+    det = AnomalyDetector()
+    mon = HealthMonitor(every=1, detector=det)
+    for step in range(1, 13):
+        g = np.array([0.1 * (1 + i) for i in range(L)])
+        g = g * (1.0 + 0.001 * ((step * 5 + np.arange(L)) % 7 - 3))
+        if step >= 10:
+            g[DIV_LAYER] *= 100.0
+        load = np.full(E, (1.0 - 0.001) / (E - 1))
+        load[DEAD_EXPERT] = 0.001
+        mon.publish(step, {"grad_norm": g, "expert_load": load},
+                    aux_loss=0.02)
+    div = {a.get("layer") for a in det.anomalies
+           if a["kind"] == "layer_divergence"}
+    dead = {a.get("expert") for a in det.anomalies
+            if a["kind"] == "expert_collapse"}
+    assert div == {DIV_LAYER}
+    assert dead == {DEAD_EXPERT}
+    snap = registry.snapshot(interval=False)
+    assert snap.get("health/anomaly") == 1.0
+    assert snap.get("health/worst_layer") == float(DIV_LAYER)
+    assert snap.get("health/worst_expert") == float(DEAD_EXPERT)
+
+    events = [{**{k: v for k, v in rec.items() if k != "kind"},
+               "kind": "anomaly", "anomaly": rec["kind"]}
+              for rec in det.anomalies]
+    report = doctor.analyze([{"meta": {"hostname": "drillhost"},
+                              "steps": [], "events": events}])
+    verdict = report["verdict"]
+    assert verdict.startswith("LAYER DIVERGENCE")
+    assert f"layer {DIV_LAYER}" in verdict and "z=" in verdict
+    rendered = doctor.render(report)
+    assert "model health" in rendered
+    assert f"expert {DEAD_EXPERT}" in rendered
+
+    # expert collapse alone (no layer flags) gets its own verdict tier
+    exp_events = [e for e in events if e["anomaly"] == "expert_collapse"]
+    report2 = doctor.analyze([{"meta": {"hostname": "drillhost"},
+                               "steps": [], "events": exp_events}])
+    assert report2["verdict"].startswith("EXPERT COLLAPSE")
+    assert f"expert {DEAD_EXPERT}" in report2["verdict"]
+
+
+# ------------------------------------------------------- dstpu-top line
+
+def test_fleet_health_subline_when_latched():
+    from deepspeed_tpu.telemetry import fleet
+
+    metrics = {"health_anomaly": 1.0, "health_worst_layer": 7.0,
+               "health_worst_layer_z": 12.3, "health_dead_experts": 1.0,
+               "health_worst_expert": 2.0,
+               "health_worst_expert_load": 0.0012}
+    state = fleet.health_state(metrics)
+    assert state == {"layer": 7.0, "z": 12.3, "dead": 1.0,
+                     "expert": 2.0, "load": 0.0012}
+    row = {"host": "h1", "status": "ok", "reason": "", "health": state}
+    table = fleet.render_table([row])
+    assert "└─ health:" in table
+    assert "worst layer 7 z=+12.3" in table
+    assert "dead experts 1 (worst 2@0.0012)" in table
+    # latch down → no sub-line
+    assert fleet.health_state({"health_anomaly": 0.0,
+                               "health_worst_layer": 7.0}) is None
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_dstpu_health_cli_selftest(capsys):
+    assert health.main(["--selftest"]) == 0
+    out = capsys.readouterr().out
+    assert "dstpu-health selftest: OK" in out
+    assert "LAYER DIVERGENCE" in out
+
+
+def test_dstpu_health_history_rendering(tmp_path):
+    """History-mode CLI renders per-layer sparklines from metric-history
+    JSONL (the same records MetricHistory appends)."""
+    import json
+    p = tmp_path / "hist.jsonl"
+    with open(p, "w") as fh:
+        for step in range(1, 17):
+            m = {f"health/layer/{i}/grad_norm":
+                 0.1 * (1 + i) * (10.0 if (i == 2 and step > 14) else 1.0)
+                 for i in range(4)}
+            m["health/expert/0/load"] = 0.5
+            m["health/expert/1/load"] = 0.5
+            m["health/layers"] = 4.0
+            fh.write(json.dumps({"ts": float(step), "step": step,
+                                 "m": m}) + "\n")
+    rep = health.report_from_frames(
+        [health._flatten(r) for r in
+         __import__("deepspeed_tpu.telemetry.timeseries",
+                    fromlist=["load_records"]).load_records(str(p))])
+    layers = {r["layer"] for r in rep["layers"]}
+    assert layers == {0, 1, 2, 3}
+    worst = max(rep["layers"], key=lambda r: abs(r.get("z") or 0.0))
+    assert worst["layer"] == 2
+    assert health.main([str(p)]) == 0

@@ -47,9 +47,9 @@ _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|\{\})$")
 #: the metric catalog's areas (docs/observability.md) — extend here AND
 #: in the docs when a new subsystem starts publishing
 KNOWN_AREAS = ("anomaly", "autoscale", "comm", "compile", "dispatch",
-               "fleet", "goodput", "handoff", "kvtier", "mem", "overlap",
-               "resilience", "roofline", "router", "serving", "slo",
-               "trace", "train", "tune")
+               "fleet", "goodput", "handoff", "health", "kvtier", "mem",
+               "overlap", "resilience", "roofline", "router", "serving",
+               "slo", "trace", "train", "tune")
 
 #: span-emitting methods (Tracer / ReqTrace) linted by the span-catalog
 #: check below
@@ -215,6 +215,48 @@ def check_goodput_categories(pkg_root: str) -> List[str]:
             for c in cats if c not in doc]
 
 
+def collect_health_stats(pkg_root: str) -> List[str]:
+    """Every model-health gauge name declared in telemetry/health.py:
+    the string elements of module-level ``*_STATS`` tuple assignments
+    (the catalog ``HealthMonitor.publish`` emits from)."""
+    path = os.path.join(pkg_root, "telemetry", "health.py")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    stats: List[str] = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_STATS")):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                stats.append(sub.value)
+    return list(dict.fromkeys(stats))
+
+
+def check_health_stats(pkg_root: str) -> List[str]:
+    """Every declared health stat must appear in docs/observability.md —
+    mirrors the goodput-category check: an undocumented health gauge is
+    a training-dynamics signal nobody can interpret from the runbook."""
+    stats = collect_health_stats(pkg_root)
+    if not stats:
+        return []
+    doc_path = os.path.join(os.path.dirname(pkg_root), "docs",
+                            "observability.md")
+    if not os.path.exists(doc_path):
+        return [f"docs/observability.md missing but telemetry/health.py "
+                f"declares {len(stats)} health stats"]
+    with open(doc_path, encoding="utf-8") as fh:
+        doc = fh.read()
+    return [f"telemetry/health.py declares health stat {s!r} but "
+            f"docs/observability.md never mentions it (document it in "
+            f"the model-health catalog)"
+            for s in stats if s not in doc]
+
+
 def collect_span_names(pkg_root: str) -> List[Tuple[str, int, str]]:
     """(file, line, span_name) for every literal-name ``span`` /
     ``instant`` / ``complete`` call site under the serving tier
@@ -285,6 +327,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     errors += check_fault_kinds(root)
     errors += check_span_names(root)
     errors += check_goodput_categories(root)
+    errors += check_health_stats(root)
     for e in errors:
         print(e)
     if not errors:
@@ -293,7 +336,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(collect_fault_kinds(root))} fault kinds documented; "
               f"{len(spans)} span names documented; "
               f"{len(collect_goodput_categories(root))} goodput "
-              f"categories documented")
+              f"categories documented; "
+              f"{len(collect_health_stats(root))} health stats "
+              f"documented")
     return 1 if errors else 0
 
 
